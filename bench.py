@@ -1,0 +1,87 @@
+"""Benchmark: EC(12,4) 8 MiB-stripe encode throughput on one TPU chip.
+
+The headline metric of BASELINE.md's north star: GF(2^8) Reed-Solomon encode
+expressed as an int8 bit-matrix matmul on the MXU, target >= 40 GB/s/chip on
+v5e-1 (vs_baseline is value/40.0). Prints exactly ONE JSON line on stdout;
+diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from chubaofs_tpu.models import FLAGSHIP
+from chubaofs_tpu.ops import rs
+
+TARGET_GBPS = 40.0
+BATCH = 16  # stripes per device call (16 x 8 MiB = 128 MiB data per step)
+TIMED_ITERS = 10
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    t = FLAGSHIP.tactic
+    n, m, k = t.N, t.M, FLAGSHIP.shard_len
+    kernel = rs.get_kernel(n, m)
+    dev = jax.devices()[0]
+    log(f"device={dev} layout=EC({n},{m}) shard_len={k} batch={BATCH}")
+
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, (BATCH, n, k), dtype=np.uint8)
+    ddata = jax.device_put(jnp.asarray(data), dev)
+
+    encode = jax.jit(kernel.encode_parity)
+    encode(ddata).block_until_ready()  # compile
+    # warmup steady-state
+    for _ in range(3):
+        out = encode(ddata)
+    out.block_until_ready()
+
+    start = time.perf_counter()
+    for _ in range(TIMED_ITERS):
+        out = encode(ddata)
+    out.block_until_ready()
+    elapsed = time.perf_counter() - start
+
+    data_bytes = BATCH * n * k * TIMED_ITERS
+    gbps = data_bytes / elapsed / 1e9
+    log(f"encode: {gbps:.2f} GB/s ({elapsed*1e3/TIMED_ITERS:.2f} ms/step)")
+
+    # secondary: full-stripe reconstruct with 1 missing data shard (target 25 GB/s)
+    stripe = jax.jit(kernel.encode)(ddata)
+    plan = kernel.repair_plan([0])
+    rec = jax.jit(kernel.apply_repair)
+    rec(plan, stripe).block_until_ready()
+    start = time.perf_counter()
+    for _ in range(TIMED_ITERS):
+        r = rec(plan, stripe)
+    r.block_until_ready()
+    rec_elapsed = time.perf_counter() - start
+    rec_gbps = BATCH * n * k * TIMED_ITERS / rec_elapsed / 1e9
+    log(f"reconstruct(1 data shard): {rec_gbps:.2f} GB/s")
+
+    print(
+        json.dumps(
+            {
+                "metric": "ec12p4_encode_8mib_stripe",
+                "value": round(gbps, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(gbps / TARGET_GBPS, 4),
+                "reconstruct_1shard_gbps": round(rec_gbps, 3),
+                "device": str(dev),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,24 @@
+"""chubaofs_tpu — a TPU-native distributed storage framework.
+
+A brand-new framework with the capabilities of CubeFS (reference: /root/reference,
+CubeFS v3.2.1): a distributed filesystem + S3-compatible object store with two
+redundancy engines — replicated hot storage and an erasure-coded blob store — whose
+erasure-coding math (GF(2^8) Reed-Solomon / LRC) runs on TPU as batched GF(2)
+bit-matrix products on the MXU via jax.lax.dot_general and Pallas kernels.
+
+Layout:
+    ops/       TPU compute primitives: GF(2^8) tables, bit-matrix RS kernels, CRC
+    codec/     the ec.Encoder-equivalent API: codemodes, RS + LRC encoders, buffers
+    parallel/  device meshes, sharding specs, multi-chip codec dispatch
+    models/    flagship codec pipeline configs (the "model zoo" of EC layouts)
+    utils/     config, logging, byte utilities
+    blobstore/ access gateway, clustermgr, blobnode, proxy, scheduler
+    meta/      range-sharded metadata plane (metanode equivalent)
+    data/      extent storage engine + replication (datanode equivalent)
+    master/    cluster resource manager
+    raft/      consensus
+    rpc/       wire protocol + HTTP rpc framework
+    sdk/       client SDKs
+"""
+
+__version__ = "0.1.0"

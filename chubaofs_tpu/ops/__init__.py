@@ -1,0 +1,6 @@
+"""TPU compute primitives: GF(2^8) arithmetic, bit-matrix RS kernels, CRC."""
+
+from chubaofs_tpu.ops import gf256
+from chubaofs_tpu.ops import bitmatrix
+
+__all__ = ["gf256", "bitmatrix"]

@@ -1,0 +1,9 @@
+"""Device meshes and sharded codec dispatch (multi-chip scale-out)."""
+
+from chubaofs_tpu.parallel.mesh import (
+    codec_mesh,
+    shard_stripes,
+    sharded_codec_step,
+)
+
+__all__ = ["codec_mesh", "shard_stripes", "sharded_codec_step"]

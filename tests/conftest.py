@@ -1,0 +1,30 @@
+"""Test harness: run JAX on a virtual 8-device CPU mesh.
+
+Multi-chip hardware isn't available in CI; shardings are validated the way the
+reference validates multi-node logic with in-process fakes (SURVEY.md §4) — here via
+XLA's host-platform device partitioning. Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+# Some environments pre-register an accelerator plugin via sitecustomize and
+# override JAX_PLATFORMS; force the CPU backend explicitly so tests always run
+# on the virtual 8-device mesh.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

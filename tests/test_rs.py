@@ -1,0 +1,114 @@
+"""TPU RS kernels (bit-matrix matmul) vs the numpy GF(2^8) oracle."""
+
+import numpy as np
+import pytest
+
+from chubaofs_tpu.ops import bitmatrix, gf256, rs
+
+
+def test_mul_bit_matrix_matches_field(rng):
+    for c in [0, 1, 2, 3, 0x1D, 0x80, 0xFF] + list(rng.integers(0, 256, 16)):
+        mc = bitmatrix.mul_bit_matrix(int(c))
+        d = rng.integers(0, 256, 64, dtype=np.uint8)
+        bits = ((d[:, None] >> np.arange(8)) & 1).astype(np.uint8)  # (64, 8)
+        out_bits = (bits @ mc.T) % 2
+        packed = (out_bits << np.arange(8)).sum(axis=1).astype(np.uint8)
+        assert np.array_equal(packed, gf256.gf_mul(np.uint8(c), d)), hex(int(c))
+
+
+def test_unpack_pack_roundtrip_np(rng):
+    x = rng.integers(0, 256, (5, 33), dtype=np.uint8)
+    assert np.array_equal(bitmatrix.pack_bits_np(bitmatrix.unpack_bits_np(x)), x)
+
+
+def test_unpack_pack_roundtrip_jax(rng):
+    x = rng.integers(0, 256, (2, 5, 33), dtype=np.uint8)
+    assert np.array_equal(np.asarray(rs.pack_bits(rs.unpack_bits(x))), x)
+
+
+def test_expand_matrix_matches_gf_matmul(rng):
+    a = rng.integers(0, 256, (4, 6), dtype=np.uint8)
+    x = rng.integers(0, 256, (6, 100), dtype=np.uint8)
+    want = gf256.gf_matmul(a, x)
+    a_bits = bitmatrix.expand_matrix(a)
+    x_bits = bitmatrix.unpack_bits_np(x)
+    got = bitmatrix.pack_bits_np((a_bits.astype(np.int32) @ x_bits.astype(np.int32)) % 2)
+    assert np.array_equal(got, want)
+
+
+@pytest.mark.parametrize("n,m", [(3, 3), (6, 3), (12, 4), (15, 12)])
+def test_kernel_encode_matches_oracle(rng, n, m):
+    k = 257  # deliberately unaligned
+    ker = rs.get_kernel(n, m)
+    data = rng.integers(0, 256, (n, k), dtype=np.uint8)
+    want = gf256.encode_numpy(ker.gen, data)
+    got = np.asarray(ker.encode(data))
+    assert np.array_equal(got, want)
+
+
+def test_kernel_encode_batched(rng):
+    ker = rs.get_kernel(6, 3)
+    data = rng.integers(0, 256, (4, 6, 128), dtype=np.uint8)
+    got = np.asarray(ker.encode(data))
+    for b in range(4):
+        want = gf256.encode_numpy(ker.gen, data[b])
+        assert np.array_equal(got[b], want)
+
+
+@pytest.mark.parametrize(
+    "bad", [[0], [11], [15], [0, 1, 2, 3], [12, 13, 14, 15], [5, 11, 13, 15]]
+)
+def test_kernel_reconstruct(rng, bad):
+    ker = rs.get_kernel(12, 4)
+    data = rng.integers(0, 256, (12, 200), dtype=np.uint8)
+    shards = np.asarray(ker.encode(data))
+    broken = shards.copy()
+    broken[np.asarray(bad), :] = 0
+    fixed = np.asarray(ker.reconstruct(broken, bad))
+    assert np.array_equal(fixed, shards), f"pattern {bad}"
+
+
+def test_kernel_reconstruct_data_only(rng):
+    ker = rs.get_kernel(6, 3)
+    data = rng.integers(0, 256, (6, 96), dtype=np.uint8)
+    shards = np.asarray(ker.encode(data))
+    broken = shards.copy()
+    broken[2, :] = 0
+    broken[7, :] = 0
+    fixed = np.asarray(ker.reconstruct(broken, [2, 7], data_only=True))
+    assert np.array_equal(fixed[:6], data)
+    assert np.all(fixed[7] == 0)
+
+
+def test_kernel_reconstruct_batched(rng):
+    ker = rs.get_kernel(6, 3)
+    data = rng.integers(0, 256, (8, 6, 64), dtype=np.uint8)
+    shards = np.asarray(ker.encode(data))
+    broken = shards.copy()
+    broken[:, [1, 4], :] = 0
+    fixed = np.asarray(ker.reconstruct(broken, [1, 4]))
+    assert np.array_equal(fixed, shards)
+
+
+def test_kernel_too_many_missing():
+    ker = rs.get_kernel(6, 3)
+    with pytest.raises(ValueError):
+        ker.repair_matrix([0, 1, 2, 3])
+
+
+def test_kernel_verify(rng):
+    ker = rs.get_kernel(6, 3)
+    data = rng.integers(0, 256, (6, 64), dtype=np.uint8)
+    shards = np.array(ker.encode(data))
+    assert bool(ker.verify(shards))
+    shards[7, 10] ^= 0xFF
+    assert not bool(ker.verify(shards))
+
+
+def test_verify_batched(rng):
+    ker = rs.get_kernel(4, 2)
+    data = rng.integers(0, 256, (3, 4, 32), dtype=np.uint8)
+    shards = np.array(ker.encode(data))
+    shards[1, 5, 0] ^= 1
+    ok = np.asarray(ker.verify(shards))
+    assert ok.tolist() == [True, False, True]
