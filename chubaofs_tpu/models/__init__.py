@@ -31,7 +31,8 @@ class CodecModel:
 
     @property
     def shard_len(self) -> int:
-        """Per-shard bytes, 128-aligned for TPU lane tiling."""
+        """Per-shard bytes, 128-aligned for TPU lane tiling. (Kernel tiling is
+        the kernel's concern: it splits any 128-aligned length evenly.)"""
         return _align_up(-(-self.stripe_bytes // self.tactic.N), 128)
 
 

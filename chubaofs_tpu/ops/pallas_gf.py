@@ -1,0 +1,126 @@
+"""Fused Pallas kernel for the GF(2^8) bit-matrix product.
+
+The plain XLA lowering (ops/rs.gf_matmul_bytes) materializes the 8x bit expansion
+of the data in HBM (int8 bits in, int32 accumulator out), so encode throughput is
+bandwidth-bound at ~an order of magnitude more HBM traffic than the payload. This
+kernel keeps the whole unpack -> int8 MXU matmul -> parity-mask -> pack sequence in
+VMEM: HBM sees only the uint8 payload in and the uint8 result out.
+
+Layout choice (measured on v5e-1): the GF(2) matrix is stored PLANE-MAJOR — row
+b*r+p is output-bit b of GF-row p, column b*n+j is input-bit b of GF-column j — so
+the in-kernel unpack is eight scalar shifts producing whole bit-planes and the pack
+is eight plane slices OR-ed together. The byte-major order (row p*8+b) used by
+ops/bitmatrix would need (n, 8, kt) -> (8n, kt) sublane reshapes inside the kernel,
+which cost more VPU time than the matmul itself. Mosaic constraints baked in here:
+no 8/16-bit vector shifts (unpack runs in int32), no in-kernel bitwidth-changing
+bitcast, iota only in 16/32 bit (avoided entirely).
+
+Reference counterpart: the amd64 assembly loops of klauspost/reedsolomon (the only
+"math kernel" in the reference, SURVEY §2.3) — this is its TPU replacement.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BITS = 8
+DEFAULT_TILE_K = 32768
+
+
+def _perm(dim: int) -> list[int]:
+    """plane-major index b*dim+i -> byte-major index i*8+b, for one axis."""
+    return [(i % dim) * BITS + i // dim for i in range(dim * BITS)]
+
+
+def plane_major(mat_bits: np.ndarray) -> np.ndarray:
+    """Permute a byte-major (8r, 8n) GF(2) matrix to the kernel's plane-major order."""
+    r8, n8 = mat_bits.shape
+    return np.asarray(mat_bits)[_perm(r8 // BITS)][:, _perm(n8 // BITS)]
+
+
+def _gf_kernel(mat_ref, data_ref, out_ref):
+    """One (batch, k-tile) grid step: out = (mat @ bits(data)) mod 2, packed.
+
+    mat_ref:  (8r, 8n) int8, plane-major — resident in VMEM for all grid steps
+    data_ref: (1, n, kt) uint8
+    out_ref:  (1, r, kt) uint8
+    """
+    r = out_ref.shape[1]
+    data32 = data_ref[0].astype(jnp.int32)  # Mosaic has no 8-bit vector shifts
+    planes = [((data32 >> b) & 1).astype(jnp.int8) for b in range(BITS)]
+    bits = jnp.concatenate(planes, axis=0)  # (8n, kt), plane-major
+
+    acc = jax.lax.dot_general(
+        mat_ref[...],
+        bits,
+        dimension_numbers=(((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )  # (8r, kt) int32, plane-major rows
+    packed = acc[0:r] & 1
+    for b in range(1, BITS):
+        packed |= (acc[b * r : (b + 1) * r] & 1) << b
+    out_ref[0] = packed.astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("tile_k", "interpret"))
+def gf_matmul_bytes_fused(
+    mat_bits: jax.Array,
+    shards: jax.Array,
+    tile_k: int = DEFAULT_TILE_K,
+    interpret: bool = False,
+) -> jax.Array:
+    """Drop-in fused equivalent of rs.gf_matmul_bytes.
+
+    mat_bits: (8r, 8n) int8 in the standard byte-major order (the plane-major
+    permutation happens here, traced once under jit); shards: (..., n, k) uint8
+    -> (..., r, k) uint8. k is padded to the tile size internally and sliced back.
+    """
+    r8, n8 = mat_bits.shape
+    r, n = r8 // BITS, n8 // BITS
+    lead = shards.shape[:-2]
+    k = shards.shape[-1]
+    assert shards.shape[-2] == n, (shards.shape, mat_bits.shape)
+    if r8 == 0 or k == 0:
+        return jnp.zeros((*lead, r, k), jnp.uint8)
+
+    mat_pm = mat_bits[jnp.asarray(_perm(r))][:, jnp.asarray(_perm(n))]
+
+    b = 1
+    for d in lead:
+        b *= d
+    data = shards.reshape(b, n, k)
+
+    # pick the tile so the grid divides evenly with minimal padding: distribute
+    # the 128-aligned length over ceil(k/tile_k) tiles (pad <= 128 * n_tiles
+    # instead of up to a full tile)
+    k128 = -(-k // 128) * 128
+    n_tiles = max(1, -(-k128 // tile_k))
+    kt = -(-k128 // n_tiles // 128) * 128
+    kp = kt * n_tiles
+    if kp != k:
+        data = jnp.pad(data, ((0, 0), (0, 0), (0, kp - k)))
+
+    out = pl.pallas_call(
+        _gf_kernel,
+        grid=(b, kp // kt),
+        in_specs=[
+            pl.BlockSpec((r8, n8), lambda i, j: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, n, kt), lambda i, j: (i, 0, j)),
+        ],
+        out_specs=pl.BlockSpec((1, r, kt), lambda i, j: (i, 0, j)),
+        out_shape=jax.ShapeDtypeStruct((b, r, kp), jnp.uint8),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel")
+        ),
+        interpret=interpret,
+    )(mat_pm, data)
+
+    if kp != k:
+        out = out[..., :k]
+    return out.reshape(*lead, r, k)

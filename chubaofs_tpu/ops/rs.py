@@ -48,7 +48,7 @@ def pack_bits(bits: jax.Array) -> jax.Array:
 
 @jax.jit
 def gf_matmul_bytes(mat_bits: jax.Array, shards: jax.Array) -> jax.Array:
-    """GF(2^8) matrix product via the bit-matrix lowering.
+    """GF(2^8) matrix product via the bit-matrix lowering (portable XLA path).
 
     mat_bits: (8r, 8n) int8 GF(2) matrix (from bitmatrix.expand_matrix).
     shards:   (..., n, k) uint8.
@@ -62,6 +62,24 @@ def gf_matmul_bytes(mat_bits: jax.Array, shards: jax.Array) -> jax.Array:
         preferred_element_type=jnp.int32,
     )
     return pack_bits(acc & 1)
+
+
+def _use_fused() -> bool:
+    """The fused Pallas kernel runs on real TPU backends only; the XLA einsum
+    path serves CPU (tests, host fallback) and sharded tracing."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def gf_matmul_dispatch(mat_bits: jax.Array, shards: jax.Array) -> jax.Array:
+    """Pick the fastest available lowering for a standalone (non-traced) call."""
+    if _use_fused():
+        from chubaofs_tpu.ops import pallas_gf
+
+        return pallas_gf.gf_matmul_bytes_fused(mat_bits, shards)
+    return gf_matmul_bytes(mat_bits, shards)
 
 
 @jax.jit
@@ -92,15 +110,23 @@ class RSKernel:
         )
 
     # -- encode ------------------------------------------------------------
+    #
+    # portable=True forces the XLA einsum lowering, which GSPMD partitions
+    # cleanly over sharded operands; the fused Pallas kernel has no automatic
+    # partitioning rule, so sharded call sites (parallel/mesh.py) must opt out
+    # of the dispatch.
 
-    def encode_parity(self, data: jax.Array) -> jax.Array:
+    def encode_parity(self, data: jax.Array, *, portable: bool = False) -> jax.Array:
         """(..., n, k) data -> (..., m, k) parity."""
-        return gf_matmul_bytes(self.parity_bits, jnp.asarray(data))
+        fn = gf_matmul_bytes if portable else gf_matmul_dispatch
+        return fn(self.parity_bits, jnp.asarray(data))
 
-    def encode(self, data: jax.Array) -> jax.Array:
+    def encode(self, data: jax.Array, *, portable: bool = False) -> jax.Array:
         """(..., n, k) data -> (..., n+m, k) full stripe."""
         data = jnp.asarray(data)
-        return jnp.concatenate([data, self.encode_parity(data)], axis=-2)
+        return jnp.concatenate(
+            [data, self.encode_parity(data, portable=portable)], axis=-2
+        )
 
     # -- reconstruct -------------------------------------------------------
 
@@ -132,11 +158,14 @@ class RSKernel:
         mat_bits = jnp.asarray(bitmatrix.expand_matrix(mat).astype(np.int8))
         return mat_bits, jnp.asarray(present), jnp.asarray(missing)
 
-    def apply_repair(self, plan, shards: jax.Array) -> jax.Array:
+    def apply_repair(self, plan, shards: jax.Array, *, portable: bool = False) -> jax.Array:
         """Apply a repair_plan to (..., n+m, k) shards (jit-friendly)."""
         mat_bits, present, missing = plan
+        if missing.shape[0] == 0:
+            return shards
         survivors = jnp.take(shards, present, axis=-2)
-        rows = gf_matmul_bytes(mat_bits, survivors)
+        fn = gf_matmul_bytes if portable else gf_matmul_dispatch
+        rows = fn(mat_bits, survivors)
         return shards.at[..., missing, :].set(rows)
 
     def reconstruct(self, shards, bad_idx: list[int], data_only: bool = False):
@@ -149,10 +178,10 @@ class RSKernel:
 
     # -- verify ------------------------------------------------------------
 
-    def verify(self, shards) -> jax.Array:
+    def verify(self, shards, *, portable: bool = False) -> jax.Array:
         """(..., n+m, k) -> scalar/batch bool: parity rows match re-encoded parity."""
         shards = jnp.asarray(shards)
-        expect = self.encode_parity(shards[..., : self.n, :])
+        expect = self.encode_parity(shards[..., : self.n, :], portable=portable)
         got = shards[..., self.n :, :]
         return jnp.all(expect == got, axis=(-2, -1))
 

@@ -63,9 +63,11 @@ def sharded_codec_step(mesh: Mesh, n: int, m: int):
     plan = kernel.repair_plan([0, n])
 
     def step(data):
-        stripe = kernel.encode(data)  # (B, n+m, k)
-        ok = kernel.verify(stripe)  # (B,) — jnp.all over sharded k: ICI all-reduce
-        repaired = kernel.apply_repair(plan, stripe)
+        # portable=True: the XLA einsum lowering partitions over the mesh; the
+        # fused Pallas kernel has no GSPMD partitioning rule
+        stripe = kernel.encode(data, portable=True)  # (B, n+m, k)
+        ok = kernel.verify(stripe, portable=True)  # (B,) — all-reduce over sp
+        repaired = kernel.apply_repair(plan, stripe, portable=True)
         return stripe, ok, repaired
 
     jitted = jax.jit(step, out_shardings=(out_spec, ok_spec, out_spec))

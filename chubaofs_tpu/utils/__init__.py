@@ -1,0 +1,1 @@
+"""Shared utilities: CRC framing, config, logging, byte helpers."""
