@@ -22,15 +22,14 @@ from __future__ import annotations
 import hashlib
 import hmac
 import json
-import threading
 import zlib
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass, field
 
 import numpy as np
 
-from chubaofs_tpu.blobstore.blobnode import BlobNode, BlobNodeError
-from chubaofs_tpu.blobstore.clustermgr import ClusterMgr, VolumeInfo, parse_vuid
+from chubaofs_tpu.blobstore.blobnode import BlobNode
+from chubaofs_tpu.blobstore.clustermgr import ClusterMgr, VolumeInfo
 from chubaofs_tpu.blobstore.proxy import Proxy
 from chubaofs_tpu.codec.codemode import CodeMode, get_tactic
 from chubaofs_tpu.codec.service import CodecService, default_service
@@ -44,6 +43,10 @@ class AccessError(Exception):
 
 class QuorumError(AccessError):
     pass
+
+
+class VolumeFullError(AccessError):
+    """Quorum failed because the volume's chunks are full — rotate volumes."""
 
 
 class LocationError(AccessError):
@@ -157,7 +160,14 @@ class Access:
             stripe = fut.result()  # (N+M, shard_len)
             if t.L:
                 stripe = self._append_local_parity(t, stripe)
-            self._write_stripe(t, vol, bid, stripe)
+            try:
+                self._write_stripe(t, vol, bid, stripe)
+            except VolumeFullError:
+                # rotate: retire the full volume, take a fresh one, retry once
+                self.cm.set_volume_status(vol.vid, "idle")
+                self.proxy.invalidate(mode)
+                vol = self.proxy.alloc_volume(mode)
+                self._write_stripe(t, vol, bid, stripe)
             loc.blobs.append(Blob(bid=bid, vid=vol.vid, size=size))
 
         loc.signature = self._sign(loc)
@@ -188,6 +198,10 @@ class Access:
         ok = [i for i, r in zip(range(t.total), results) if r is None]
         failed = [i for i, r in zip(range(t.total), results) if r is not None]
         if len(ok) < t.put_quorum:
+            from chubaofs_tpu.blobstore.blobnode import ChunkFull
+
+            if any(isinstance(r, ChunkFull) for r in results):
+                raise VolumeFullError(f"volume {vol.vid} chunks full")
             raise QuorumError(
                 f"wrote {len(ok)}/{t.total} shards, quorum {t.put_quorum}; failures: {failed}"
             )

@@ -281,6 +281,16 @@ class ClusterMgr:
                     return vol
             return self.create_volume(mode)
 
+    def set_volume_status(self, vid: int, status: str) -> None:
+        """Retire full volumes (VOL_IDLE) so alloc_volume rotates to a new one."""
+        self.apply("set_volume_status", {"vid": vid, "status": status})
+
+    def _op_set_volume_status(self, vid: int, status: str):
+        vol = self.volumes.get(vid)
+        if vol is None:
+            raise ClusterError(f"unknown volume {vid}")
+        vol.status = status
+
     def update_volume_unit(self, vid: int, index: int, new_disk_id: int) -> VolumeUnit:
         """Re-home a stripe position after repair/migration (epoch bump)."""
         return self.apply(

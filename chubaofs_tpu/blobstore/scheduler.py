@@ -41,6 +41,7 @@ from chubaofs_tpu.codec.service import CodecService, default_service
 TASK_PREPARED = "prepared"
 TASK_WORKING = "working"
 TASK_FINISHED = "finished"
+TASK_FAILED = "failed"  # exhausted retries; eligible for re-creation
 
 KIND_SHARD_REPAIR = "shard_repair"
 KIND_DISK_REPAIR = "disk_repair"
@@ -63,6 +64,7 @@ class Task:
     dest_disk_id: int = 0
     created: float = field(default_factory=time.time)
     retries: int = 0
+    error: str = ""
 
 
 class Scheduler:
@@ -129,8 +131,12 @@ class Scheduler:
         no-two-units-of-a-volume-per-disk invariant holds."""
         out = []
         for disk in self.cm.broken_disks():
+            # an open (prepared/working) task blocks re-creation; a FAILED one
+            # does not — the disk is still broken and must be retried
             existing = [
-                t for t in self.tasks(KIND_DISK_REPAIR) if t.disk_id == disk.disk_id
+                t
+                for t in self.tasks(KIND_DISK_REPAIR)
+                if t.disk_id == disk.disk_id and t.state in (TASK_PREPARED, TASK_WORKING)
             ]
             if existing:
                 continue
@@ -164,14 +170,15 @@ class Scheduler:
                         return t
         return None
 
-    def report_task(self, task_id: str, ok: bool) -> None:
+    def report_task(self, task_id: str, ok: bool, error: str = "") -> None:
         with self._lock:
             t = self._tasks[task_id]
             if ok:
                 t.state = TASK_FINISHED
             else:
                 t.retries += 1
-                t.state = TASK_PREPARED if t.retries < 3 else TASK_FINISHED
+                t.error = error
+                t.state = TASK_PREPARED if t.retries < 3 else TASK_FAILED
 
     # -- blob deleter ---------------------------------------------------------
 
@@ -205,14 +212,15 @@ class RepairWorker:
     """
 
     def __init__(self, sched: Scheduler, nodes: dict[int, BlobNode],
-                 codec: CodecService | None = None, batch: int = 64):
+                 codec: CodecService | None = None):
         self.sched = sched
         self.cm = sched.cm
         self.nodes = nodes
         self.codec = codec or sched.codec
-        self.batch = batch
 
     def run_once(self) -> bool:
+        """Process one task; failures are recorded on the task, never raised —
+        one poisoned stripe must not stall the background plane."""
         task = self.sched.acquire_task()
         if task is None:
             return False
@@ -222,10 +230,9 @@ class RepairWorker:
             elif task.kind in (KIND_DISK_REPAIR, KIND_DISK_DROP, KIND_BALANCE):
                 self._migrate_disk(task)
             self.sched.report_task(task.task_id, True)
-            return True
-        except Exception:
-            self.sched.report_task(task.task_id, False)
-            raise
+        except Exception as e:
+            self.sched.report_task(task.task_id, False, error=f"{type(e).__name__}: {e}")
+        return True
 
     # -- single-stripe shard repair -------------------------------------------
 
@@ -288,7 +295,10 @@ class RepairWorker:
                     bids.update(m.bid for m in node.list_shards(u.vuid))
                 except Exception:
                     continue
+            # phase 1: source copies or reconstruct futures (submitted together so
+            # the codec service batches them into shared device calls)
             rows: dict[int, bytes] = {}
+            futures: dict[int, object] = {}
             for bid in sorted(bids):
                 if not source_broken:
                     try:
@@ -301,8 +311,12 @@ class RepairWorker:
                 if unit.index in present:
                     rows[bid] = stripe[unit.index].tobytes()
                 else:
-                    fixed = self.codec.reconstruct(t.N, t.M, stripe, [unit.index]).result()
-                    rows[bid] = fixed[unit.index].tobytes()
+                    # repair with the FULL missing set: zero-filled absent rows
+                    # must never be treated as survivors
+                    missing = [i for i in range(t.N + t.M) if i not in present]
+                    futures[bid] = self.codec.reconstruct(t.N, t.M, stripe, missing)
+            for bid, fut in futures.items():
+                rows[bid] = fut.result()[unit.index].tobytes()
 
             dest = self._dest_for(vol, task.disk_id)
             new_unit = self.cm.update_volume_unit(vol.vid, unit.index, dest)

@@ -1,0 +1,115 @@
+"""FsCluster — a full in-process deployment: masters + metanodes + blobstore.
+
+Reference analog: docker/docker-compose.yml's 3-master/4-metanode/4-datanode
+bring-up (SURVEY §4), collapsed into one process for tests and embedded use.
+Node layout: raft nodes 1..N each host the master group (GROUP 1) and any meta
+partition groups placed on them; file data rides the erasure-coded blobstore
+(cold-tier path) through the TPU codec service.
+"""
+
+from __future__ import annotations
+
+import os
+
+from chubaofs_tpu.blobstore.cluster import MiniCluster
+from chubaofs_tpu.master.master import Master, MasterSM, MASTER_GROUP, MasterError
+from chubaofs_tpu.meta.metanode import MetaNode
+from chubaofs_tpu.raft.server import InProcNet, MultiRaft, NotLeaderError, run_until
+from chubaofs_tpu.sdk.fs import FsClient
+from chubaofs_tpu.sdk.meta_wrapper import MetaWrapper
+
+
+class BlobstoreBackend:
+    """FsClient data backend over the blobstore access gateway."""
+
+    def __init__(self, blobstore: MiniCluster):
+        self.bs = blobstore
+
+    def write(self, data: bytes) -> str:
+        return self.bs.access.put(data).to_json()
+
+    def read(self, loc: str, offset: int, size: int) -> bytes:
+        return self.bs.access.get(loc, offset, size)
+
+    def delete(self, loc: str) -> None:
+        self.bs.access.delete(loc)
+
+
+class FsCluster:
+    def __init__(self, root: str, n_nodes: int = 3, blob_nodes: int = 9):
+        self.root = root
+        self.net = InProcNet()
+        self.rafts: dict[int, MultiRaft] = {}
+        self.master_sms: dict[int, MasterSM] = {}
+        self.masters: dict[int, Master] = {}
+        self.metanodes: dict[int, MetaNode] = {}
+
+        for i in range(1, n_nodes + 1):
+            raft = MultiRaft(i, self.net, wal_dir=os.path.join(root, f"raft{i}"),
+                             snapshot_every=512)
+            self.rafts[i] = raft
+            sm = MasterSM()
+            self.master_sms[i] = sm
+            raft.create_group(MASTER_GROUP, list(range(1, n_nodes + 1)), sm)
+            self.masters[i] = Master(raft, sm)
+            self.metanodes[i] = MetaNode(i, raft)
+
+        for i, m in self.masters.items():
+            m.metanode_hook = self._create_meta_partition
+
+        self.blobstore = MiniCluster(os.path.join(root, "blob"), n_nodes=blob_nodes,
+                                     disks_per_node=2)
+        self.data_backend = BlobstoreBackend(self.blobstore)
+
+        self.settle()
+        lead = self.master()
+        for i in self.metanodes:
+            lead.register_node(i, "meta")
+        # restart path: re-host every meta partition recorded in the recovered
+        # master state; each group's WAL/snapshot replays its namespace
+        for vol in list(lead.sm.volumes.values()):
+            for mp in vol.meta_partitions:
+                self._create_meta_partition(mp.partition_id, mp.start, mp.end, mp.peers)
+
+    # -- pumping -----------------------------------------------------------------
+
+    def settle(self, cond=None, max_ticks: int = 600) -> bool:
+        """Pump raft clocks until cond (default: master leader elected)."""
+        cond = cond or (lambda: any(m.is_leader for m in self.masters.values()))
+        return run_until(self.net, cond, max_ticks=max_ticks)
+
+    def tick_background(self):
+        """One pass of the master's background loops + metanode freelists."""
+        lead = self.master()
+        lead.check_meta_partitions()
+        lead.refresh_leaders(lambda pid: next(
+            (r.leader_of(pid) for r in self.rafts.values() if r.leader_of(pid)), None
+        ))
+        for mn in self.metanodes.values():
+            mn.drain_freelists()
+        self.blobstore.run_background_once()
+
+    # -- components ----------------------------------------------------------------
+
+    def master(self) -> Master:
+        for m in self.masters.values():
+            if m.is_leader:
+                return m
+        raise MasterError("no master leader")
+
+    def _create_meta_partition(self, pid: int, start: int, end: int, peers: list[int]):
+        for peer in peers:
+            self.metanodes[peer].create_partition(pid, start, end, peers)
+        self.settle(lambda: any(self.rafts[p].is_leader(pid) for p in peers))
+
+    # -- volumes ---------------------------------------------------------------------
+
+    def create_volume(self, name: str, cold: bool = True) -> None:
+        self.master().create_volume(name, cold=cold)
+
+    def client(self, volume: str) -> FsClient:
+        meta = MetaWrapper(self.master(), self.metanodes, volume)
+        return FsClient(meta, self.data_backend)
+
+    def close(self):
+        self.blobstore.close()

@@ -1,0 +1,5 @@
+"""Master — cluster resource manager (reference master/ equivalent)."""
+
+from chubaofs_tpu.master.master import Master, MasterSM, VolumeView, MetaPartitionView
+
+__all__ = ["Master", "MasterSM", "VolumeView", "MetaPartitionView"]

@@ -1,0 +1,259 @@
+"""Master — the cluster's resource manager, one raft group over all masters.
+
+Reference counterpart: master/ (Server.Start server.go:137-175, single raft
+group ID 1, MetadataFsm, Cluster.scheduleTask's 16 background loops
+cluster.go:329-3587, IDAllocator id_allocator.go:176-272, vol/meta-partition
+management vol.go + meta_partition.go). Kept:
+
+  * every mutation is a raft-applied op on MasterSM (the MetadataFsm analog);
+  * volumes own a list of meta partitions, each an inode range [start, end)
+    replicated across 3 metanodes; the last partition is unbounded and is SPLIT
+    when its cursor approaches the range end (meta_partition splitting);
+  * node registry with heartbeats; background check loops are explicit tick
+    methods (check_meta_partitions) the deployment pumps, like scheduleTask.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from chubaofs_tpu.raft.server import MultiRaft, StateMachine
+
+MASTER_GROUP = 1
+META_RANGE_STEP = 1 << 24  # inos per partition before splitting
+SPLIT_HEADROOM = 1 << 20  # split when cursor is this close to the end
+INF = 1 << 63
+
+
+class MasterError(Exception):
+    pass
+
+
+@dataclass
+class NodeInfo:
+    node_id: int
+    kind: str  # "meta" | "data"
+    addr: str = ""
+    last_heartbeat: float = 0.0
+    partition_count: int = 0
+    cursors: dict[int, int] = field(default_factory=dict)  # pid -> cursor (meta)
+
+
+@dataclass
+class MetaPartitionView:
+    partition_id: int
+    start: int
+    end: int  # exclusive; INF for the tail partition
+    peers: list[int] = field(default_factory=list)
+    leader: int | None = None
+
+
+@dataclass
+class VolumeView:
+    name: str
+    vol_id: int
+    owner: str = ""
+    capacity: int = 0
+    cold: bool = False  # cold volumes store data in the blobstore (EC tier)
+    meta_partitions: list[MetaPartitionView] = field(default_factory=list)
+
+
+class MasterSM(StateMachine):
+    """Replicated master state (MetadataFsm + Cluster state analog)."""
+
+    def __init__(self):
+        self.nodes: dict[int, NodeInfo] = {}
+        self.volumes: dict[str, VolumeView] = {}
+        self.next_id = 100  # shared id space for volumes + partitions
+
+    # raft hooks -------------------------------------------------------------
+
+    def apply(self, data, index: int):
+        op, args = data
+        try:
+            return ("ok", getattr(self, "_op_" + op)(**args))
+        except MasterError as e:
+            return ("err", str(e))
+
+    def snapshot(self) -> bytes:
+        import pickle
+
+        return pickle.dumps((self.nodes, self.volumes, self.next_id))
+
+    def restore(self, payload: bytes) -> None:
+        import pickle
+
+        self.nodes, self.volumes, self.next_id = pickle.loads(payload)
+
+    # ops ---------------------------------------------------------------------
+
+    def _op_alloc_id(self):
+        self.next_id += 1
+        return self.next_id
+
+    def _op_register_node(self, node_id: int, kind: str, addr: str):
+        if node_id not in self.nodes:
+            self.nodes[node_id] = NodeInfo(node_id, kind, addr)
+        self.nodes[node_id].last_heartbeat = time.time()
+        return node_id
+
+    def _op_heartbeat(self, node_id: int, partition_count: int = 0, cursors: dict | None = None):
+        n = self.nodes.get(node_id)
+        if n is None:
+            raise MasterError(f"unknown node {node_id}")
+        n.last_heartbeat = time.time()
+        n.partition_count = partition_count
+        if cursors:
+            n.cursors.update({int(k): v for k, v in cursors.items()})
+        return None
+
+    def _op_create_volume(self, name: str, owner: str, capacity: int, cold: bool,
+                          vol_id: int, partition_id: int, peers: list[int]):
+        if name in self.volumes:
+            raise MasterError(f"volume {name!r} exists")
+        vol = VolumeView(name=name, vol_id=vol_id, owner=owner, capacity=capacity, cold=cold)
+        vol.meta_partitions.append(
+            MetaPartitionView(partition_id, start=1, end=INF, peers=peers)
+        )
+        self.volumes[name] = vol
+        for p in peers:
+            if p in self.nodes:
+                self.nodes[p].partition_count += 1
+        return vol
+
+    def _op_split_partition(self, vol_name: str, partition_id: int, split_at: int,
+                            new_partition_id: int, peers: list[int]):
+        vol = self.volumes.get(vol_name)
+        if vol is None:
+            raise MasterError(f"unknown volume {vol_name!r}")
+        tail = vol.meta_partitions[-1]
+        if tail.partition_id != partition_id:
+            raise MasterError("only the tail partition splits")
+        tail.end = split_at
+        vol.meta_partitions.append(
+            MetaPartitionView(new_partition_id, start=split_at, end=INF, peers=peers)
+        )
+        return vol.meta_partitions[-1]
+
+    def _op_set_partition_leader(self, vol_name: str, partition_id: int, leader: int | None):
+        vol = self.volumes.get(vol_name)
+        if vol is None:
+            raise MasterError(f"unknown volume {vol_name!r}")
+        for mp in vol.meta_partitions:
+            if mp.partition_id == partition_id:
+                mp.leader = leader
+                return None
+        raise MasterError(f"unknown partition {partition_id}")
+
+    def _op_delete_volume(self, name: str):
+        vol = self.volumes.pop(name, None)
+        if vol is None:
+            raise MasterError(f"unknown volume {name!r}")
+        return vol
+
+
+class Master:
+    """Leader-side service facade over the replicated MasterSM.
+
+    The deployment wires `metanode_hook(partition_id, start, end, peers)` so
+    partition creation reaches the metanodes (admin-task analog of
+    master/cluster_task.go).
+    """
+
+    def __init__(self, raft: MultiRaft, sm: MasterSM):
+        self.raft = raft
+        self.sm = sm
+        self.metanode_hook = None  # (pid, start, end, peers) -> None
+
+    def _apply(self, op: str, **args):
+        res = self.raft.propose(MASTER_GROUP, (op, args)).result(timeout=5)
+        if res[0] == "err":
+            raise MasterError(res[1])
+        return res[1]
+
+    @property
+    def is_leader(self) -> bool:
+        return self.raft.is_leader(MASTER_GROUP)
+
+    # -- node admin -----------------------------------------------------------
+
+    def register_node(self, node_id: int, kind: str, addr: str = "") -> None:
+        self._apply("register_node", node_id=node_id, kind=kind, addr=addr)
+
+    def heartbeat(self, node_id: int, partition_count: int = 0, cursors: dict | None = None):
+        self._apply("heartbeat", node_id=node_id, partition_count=partition_count,
+                    cursors=cursors or {})
+
+    # -- volume admin -----------------------------------------------------------
+
+    def _pick_meta_peers(self, count: int = 3) -> list[int]:
+        metas = sorted(
+            (n for n in self.sm.nodes.values() if n.kind == "meta"),
+            key=lambda n: n.partition_count,
+        )
+        if len(metas) < count:
+            raise MasterError(f"need {count} metanodes, have {len(metas)}")
+        return [n.node_id for n in metas[:count]]
+
+    def create_volume(self, name: str, owner: str = "", capacity: int = 1 << 40,
+                      cold: bool = False) -> VolumeView:
+        vol_id = self._apply("alloc_id")
+        pid = self._apply("alloc_id")
+        peers = self._pick_meta_peers()
+        vol = self._apply(
+            "create_volume", name=name, owner=owner, capacity=capacity, cold=cold,
+            vol_id=vol_id, partition_id=pid, peers=peers,
+        )
+        if self.metanode_hook:
+            self.metanode_hook(pid, 1, INF, peers)
+        return vol
+
+    def get_volume(self, name: str) -> VolumeView:
+        vol = self.sm.volumes.get(name)
+        if vol is None:
+            raise MasterError(f"unknown volume {name!r}")
+        return vol
+
+    def delete_volume(self, name: str) -> None:
+        self._apply("delete_volume", name=name)
+
+    # -- background checks (scheduleTask loop analogs) --------------------------
+
+    def check_meta_partitions(self) -> int:
+        """Split tail partitions whose cursor nears the end (cursor growth)."""
+        if not self.is_leader:
+            return 0
+        splits = 0
+        for vol in list(self.sm.volumes.values()):
+            tail = vol.meta_partitions[-1]
+            cursor = max(
+                (n.cursors.get(tail.partition_id, 0) for n in self.sm.nodes.values()),
+                default=0,
+            )
+            bound = tail.start + META_RANGE_STEP
+            if cursor and cursor >= bound - SPLIT_HEADROOM:
+                new_pid = self._apply("alloc_id")
+                peers = self._pick_meta_peers()
+                split_at = cursor + SPLIT_HEADROOM
+                self._apply(
+                    "split_partition", vol_name=vol.name, partition_id=tail.partition_id,
+                    split_at=split_at, new_partition_id=new_pid, peers=peers,
+                )
+                if self.metanode_hook:
+                    self.metanode_hook(new_pid, split_at, INF, peers)
+                splits += 1
+        return splits
+
+    def refresh_leaders(self, leader_of) -> None:
+        """Record partition leaders into the view (client routing hint)."""
+        if not self.is_leader:
+            return
+        for vol in list(self.sm.volumes.values()):
+            for mp in vol.meta_partitions:
+                lead = leader_of(mp.partition_id)
+                if lead != mp.leader:
+                    self._apply(
+                        "set_partition_leader", vol_name=vol.name,
+                        partition_id=mp.partition_id, leader=lead,
+                    )

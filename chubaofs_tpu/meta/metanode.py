@@ -1,0 +1,114 @@
+"""MetaNode — hosts meta partitions and dispatches metadata ops.
+
+Reference counterpart: metanode/metanode.go + manager.go:103 (op dispatch) +
+partition_free_list.go (async deletion of orphaned inodes' data). Partitions are
+raft groups on the shared MultiRaft server (group id = partition id); mutations
+are proposed to the partition's leader, reads served from leader state.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future
+
+from chubaofs_tpu.meta.partition import MetaError, MetaPartitionSM, NoEntry
+from chubaofs_tpu.raft.server import MultiRaft, NotLeaderError
+
+
+class OpError(Exception):
+    def __init__(self, code: str, msg: str):
+        super().__init__(f"{code}: {msg}")
+        self.code = code
+
+
+class MetaNode:
+    def __init__(self, node_id: int, raft: MultiRaft):
+        self.node_id = node_id
+        self.raft = raft
+        self.partitions: dict[int, MetaPartitionSM] = {}
+        self._lock = threading.Lock()
+        # injected by the deployment: called with (inode) to purge file data
+        self.data_purge_hook = None
+
+    # -- partition lifecycle (master drives this) ----------------------------
+
+    def create_partition(self, partition_id: int, start: int, end: int, peers: list[int]) -> None:
+        with self._lock:
+            sm = MetaPartitionSM(partition_id, start, end)
+            self.partitions[partition_id] = sm
+            self.raft.create_group(partition_id, peers, sm)
+
+    def is_leader(self, partition_id: int) -> bool:
+        return self.raft.is_leader(partition_id)
+
+    # -- write ops: through raft ---------------------------------------------
+
+    def submit(self, partition_id: int, op: str, **args) -> Future:
+        """Propose one fsm op; future resolves to the op result or raises."""
+        fut = self.raft.propose(partition_id, (op, dict(args)))
+        out: Future = Future()
+
+        def _done(f: Future):
+            if f.exception():
+                out.set_exception(f.exception())
+                return
+            res = f.result()
+            if res[0] == "err":
+                out.set_exception(OpError(res[1], res[2]))
+            else:
+                out.set_result(res[1])
+
+        fut.add_done_callback(_done)
+        return out
+
+    def submit_sync(self, partition_id: int, op: str, timeout: float = 5.0, **args):
+        return self.submit(partition_id, op, **args).result(timeout)
+
+    # -- read ops: leader-local ------------------------------------------------
+
+    def _leader_sm(self, partition_id: int) -> MetaPartitionSM:
+        sm = self.partitions.get(partition_id)
+        if sm is None:
+            raise OpError("ENOENT", f"partition {partition_id} not on node {self.node_id}")
+        if not self.raft.is_leader(partition_id):
+            raise NotLeaderError(self.raft.leader_of(partition_id))
+        return sm
+
+    def get_inode(self, partition_id: int, ino: int):
+        try:
+            return self._leader_sm(partition_id).get_inode(ino)
+        except MetaError as e:
+            raise OpError(e.code, str(e)) from None
+
+    def lookup(self, partition_id: int, parent: int, name: str):
+        try:
+            return self._leader_sm(partition_id).lookup(parent, name)
+        except MetaError as e:
+            raise OpError(e.code, str(e)) from None
+
+    def read_dir(self, partition_id: int, parent: int):
+        try:
+            return self._leader_sm(partition_id).read_dir(parent)
+        except MetaError as e:
+            raise OpError(e.code, str(e)) from None
+
+    # -- freelist delete loop (partition_free_list.go:180,233 analog) ----------
+
+    def drain_freelists(self) -> int:
+        """Purge data of orphaned inodes on partitions this node leads."""
+        purged = 0
+        for pid in list(self.partitions):
+            if not self.raft.is_leader(pid):
+                continue
+            try:
+                drained = self.submit_sync(pid, "drain_freelist")
+            except (NotLeaderError, OpError):
+                continue
+            for ino in drained:
+                if self.data_purge_hook:
+                    try:
+                        self.data_purge_hook(ino)
+                    except Exception:
+                        pass
+                purged += 1
+        return purged

@@ -1,0 +1,343 @@
+"""MetaPartitionSM — one inode-range shard of a volume's namespace.
+
+Reference counterpart: metanode/partition.go:69-244 (metaPartition with
+start/end inode range), inode.go:57-75 (Inode with Extents + ObjExtents),
+dentry.go:42-47, the fsm ops in partition_fsmop_inode.go and the snapshot logic
+of partition_store.go. Differences by design: the store is plain dicts behind a
+raft StateMachine (ops arrive ordered and single-threaded, so btree clones and
+copy-on-write are unnecessary); snapshots are whole-state pickles through the
+raft server's snapshot hook; the orphan freelist is a queue drained by the
+metanode's delete loop (partition_free_list.go analog).
+
+Every mutating verb is a pure (op, args) command applied through raft; reads go
+through the leader's local state.
+"""
+
+from __future__ import annotations
+
+import pickle
+import stat as stat_mod
+import time
+from dataclasses import dataclass, field
+
+from chubaofs_tpu.raft.server import StateMachine
+
+ROOT_INO = 1
+
+
+class MetaError(Exception):
+    code = "EIO"
+
+
+class NoEntry(MetaError):
+    code = "ENOENT"
+
+
+class Exists(MetaError):
+    code = "EEXIST"
+
+
+class NotEmpty(MetaError):
+    code = "ENOTEMPTY"
+
+
+class NotDir(MetaError):
+    code = "ENOTDIR"
+
+
+class OutOfRange(MetaError):
+    code = "ERANGE"
+
+
+@dataclass
+class ExtentKey:
+    """Where one contiguous span of file data lives (proto/extent_key.go:40-47).
+
+    Hot volumes: (partition_id, extent_id, offset in extent). Cold volumes use
+    ObjExtentKey-style blobstore locations instead (kept as opaque dicts)."""
+
+    file_offset: int
+    size: int
+    partition_id: int = 0
+    extent_id: int = 0
+    extent_offset: int = 0
+
+
+@dataclass
+class Inode:
+    ino: int
+    mode: int  # stat-style type+perm bits
+    uid: int = 0
+    gid: int = 0
+    size: int = 0
+    nlink: int = 1
+    ctime: float = field(default_factory=time.time)
+    mtime: float = field(default_factory=time.time)
+    extents: list[ExtentKey] = field(default_factory=list)
+    obj_extents: list[dict] = field(default_factory=list)  # cold-tier locations
+    xattrs: dict[str, bytes] = field(default_factory=dict)
+
+    @property
+    def is_dir(self) -> bool:
+        return stat_mod.S_ISDIR(self.mode)
+
+
+@dataclass
+class Dentry:
+    parent: int
+    name: str
+    ino: int
+    mode: int
+
+
+class MetaPartitionSM(StateMachine):
+    """The replicated state of one meta partition (inode range [start, end))."""
+
+    def __init__(self, partition_id: int, start: int, end: int):
+        self.partition_id = partition_id
+        self.start = start
+        self.end = end
+        self.cursor = start  # last allocated ino
+        self.inodes: dict[int, Inode] = {}
+        # dentries keyed (parent_ino, name); children index for readdir
+        self.dentries: dict[tuple[int, str], Dentry] = {}
+        self.children: dict[int, dict[str, Dentry]] = {}
+        self.freelist: list[int] = []  # orphaned inos awaiting data cleanup
+        self.multipart: dict[str, dict] = {}  # S3 multipart sessions
+        self.uniq_seen: dict[int, int] = {}  # client_id -> last uniq id (idempotence)
+        if start == ROOT_INO:
+            root = Inode(ino=ROOT_INO, mode=stat_mod.S_IFDIR | 0o755, nlink=2)
+            self.inodes[ROOT_INO] = root
+            self.cursor = ROOT_INO
+
+    # -- raft StateMachine ---------------------------------------------------
+
+    def apply(self, data, index: int):
+        op, args = data
+        try:
+            return ("ok", getattr(self, "_op_" + op)(**args))
+        except MetaError as e:
+            # errors are VALUES through consensus: every replica must take the
+            # same path, and the proposer gets the errno back
+            return ("err", e.code, str(e))
+
+    def snapshot(self) -> bytes:
+        return pickle.dumps(
+            {
+                "partition_id": self.partition_id,
+                "start": self.start,
+                "end": self.end,
+                "cursor": self.cursor,
+                "inodes": self.inodes,
+                "dentries": self.dentries,
+                "freelist": self.freelist,
+                "multipart": self.multipart,
+                "uniq_seen": self.uniq_seen,
+            }
+        )
+
+    def restore(self, payload: bytes) -> None:
+        st = pickle.loads(payload)
+        self.partition_id = st["partition_id"]
+        self.start, self.end, self.cursor = st["start"], st["end"], st["cursor"]
+        self.inodes = st["inodes"]
+        self.dentries = st["dentries"]
+        self.freelist = st["freelist"]
+        self.multipart = st["multipart"]
+        self.uniq_seen = st["uniq_seen"]
+        self.children = {}
+        for d in self.dentries.values():
+            self.children.setdefault(d.parent, {})[d.name] = d
+
+    # -- fsm ops: inodes -------------------------------------------------------
+
+    def _next_ino(self) -> int:
+        if self.cursor + 1 >= self.end:
+            raise OutOfRange(f"partition {self.partition_id} inode range exhausted")
+        self.cursor += 1
+        return self.cursor
+
+    def _op_create_inode(self, mode: int, uid: int = 0, gid: int = 0):
+        ino = self._next_ino()
+        inode = Inode(ino=ino, mode=mode, uid=uid, gid=gid)
+        if inode.is_dir:
+            inode.nlink = 2
+        self.inodes[ino] = inode
+        return inode
+
+    def _op_unlink_inode(self, ino: int):
+        inode = self._get_inode(ino)
+        inode.nlink -= 1
+        if inode.is_dir:
+            inode.nlink = max(inode.nlink, 0)
+        if inode.nlink <= 0 or (inode.is_dir and inode.nlink <= 1):
+            pass  # survives until evict
+        return inode
+
+    def _op_evict_inode(self, ino: int):
+        inode = self.inodes.get(ino)
+        if inode is None:
+            return None
+        if inode.nlink <= 0 or (inode.is_dir and inode.nlink <= 1):
+            del self.inodes[ino]
+            if not inode.is_dir:
+                self.freelist.append(ino)
+        return None
+
+    def _op_update_inode(self, ino: int, size: int | None = None, mode: int | None = None,
+                         uid: int | None = None, gid: int | None = None,
+                         mtime: float | None = None):
+        inode = self._get_inode(ino)
+        if size is not None:
+            inode.size = size
+        if mode is not None:
+            inode.mode = mode
+        if uid is not None:
+            inode.uid = uid
+        if gid is not None:
+            inode.gid = gid
+        inode.mtime = mtime if mtime is not None else time.time()
+        return inode
+
+    def _op_append_extents(self, ino: int, extents: list[dict], size: int):
+        """AppendExtentKey analog (sdk/meta/api.go:1137): extend the file map."""
+        inode = self._get_inode(ino)
+        for e in extents:
+            inode.extents.append(ExtentKey(**e))
+        inode.size = max(inode.size, size)
+        inode.mtime = time.time()
+        return inode
+
+    def _op_append_obj_extents(self, ino: int, locations: list[dict], size: int):
+        """Cold tier: record blobstore locations (ObjExtents, inode.go:73-74)."""
+        inode = self._get_inode(ino)
+        inode.obj_extents.extend(locations)
+        inode.size = max(inode.size, size)
+        inode.mtime = time.time()
+        return inode
+
+    def _op_truncate(self, ino: int, size: int):
+        inode = self._get_inode(ino)
+        inode.extents = [e for e in inode.extents if e.file_offset < size]
+        for e in inode.extents:
+            if e.file_offset + e.size > size:
+                e.size = size - e.file_offset
+        # cold-tier map: obj extents are consecutive; keep those before the cut,
+        # clip the one straddling it
+        kept, pos = [], 0
+        for ext in inode.obj_extents:
+            if pos >= size:
+                break
+            if pos + ext["size"] > size:
+                ext = {**ext, "size": size - pos}
+            kept.append(ext)
+            pos += ext["size"]
+        inode.obj_extents = kept
+        inode.size = size
+        inode.mtime = time.time()
+        return inode
+
+    def _op_set_xattr(self, ino: int, key: str, value: bytes):
+        self._get_inode(ino).xattrs[key] = value
+
+    def _op_remove_xattr(self, ino: int, key: str):
+        self._get_inode(ino).xattrs.pop(key, None)
+
+    # -- fsm ops: dentries ------------------------------------------------------
+
+    def _op_create_dentry(self, parent: int, name: str, ino: int, mode: int):
+        key = (parent, name)
+        if key in self.dentries:
+            raise Exists(f"{name!r} exists in {parent}")
+        pdir = self._get_inode(parent)
+        if not pdir.is_dir:
+            raise NotDir(f"parent {parent}")
+        d = Dentry(parent, name, ino, mode)
+        self.dentries[key] = d
+        self.children.setdefault(parent, {})[name] = d
+        if stat_mod.S_ISDIR(mode):
+            pdir.nlink += 1
+        pdir.mtime = time.time()
+        return d
+
+    def _op_delete_dentry(self, parent: int, name: str):
+        key = (parent, name)
+        d = self.dentries.get(key)
+        if d is None:
+            raise NoEntry(f"{name!r} in {parent}")
+        if stat_mod.S_ISDIR(d.mode) and self.children.get(d.ino):
+            raise NotEmpty(f"{name!r}")
+        del self.dentries[key]
+        self.children.get(parent, {}).pop(name, None)
+        pdir = self.inodes.get(parent)
+        if pdir:
+            if stat_mod.S_ISDIR(d.mode):
+                pdir.nlink -= 1
+            pdir.mtime = time.time()
+        return d
+
+    def _op_rename_local(self, src_parent: int, src_name: str, dst_parent: int, dst_name: str):
+        """Atomic rename when both dentries live in this partition."""
+        d = self.dentries.get((src_parent, src_name))
+        if d is None:
+            raise NoEntry(f"{src_name!r} in {src_parent}")
+        if (dst_parent, dst_name) in self.dentries:
+            raise Exists(f"{dst_name!r} in {dst_parent}")
+        self._op_create_dentry(dst_parent, dst_name, d.ino, d.mode)
+        self._op_delete_dentry(src_parent, src_name)
+        return self.dentries[(dst_parent, dst_name)]
+
+    def _op_link(self, parent: int, name: str, ino: int):
+        inode = self._get_inode(ino)
+        if inode.is_dir:
+            raise MetaError("hardlink to directory")
+        d = self._op_create_dentry(parent, name, ino, inode.mode)
+        inode.nlink += 1
+        return d
+
+    # -- fsm ops: freelist / multipart -----------------------------------------
+
+    def _op_drain_freelist(self, max_items: int = 64):
+        drained, self.freelist = self.freelist[:max_items], self.freelist[max_items:]
+        return drained
+
+    def _op_multipart_create(self, key: str, upload_id: str):
+        self.multipart[upload_id] = {"key": key, "parts": {}}
+        return upload_id
+
+    def _op_multipart_put_part(self, upload_id: str, part_num: int, location: dict):
+        mp = self.multipart.get(upload_id)
+        if mp is None:
+            raise NoEntry(f"upload {upload_id}")
+        mp["parts"][part_num] = location
+        return part_num
+
+    def _op_multipart_complete(self, upload_id: str):
+        mp = self.multipart.pop(upload_id, None)
+        if mp is None:
+            raise NoEntry(f"upload {upload_id}")
+        return mp
+
+    # -- reads (leader-local, not through the log) ------------------------------
+
+    def _get_inode(self, ino: int) -> Inode:
+        inode = self.inodes.get(ino)
+        if inode is None:
+            raise NoEntry(f"inode {ino}")
+        return inode
+
+    def get_inode(self, ino: int) -> Inode:
+        return self._get_inode(ino)
+
+    def lookup(self, parent: int, name: str) -> Dentry:
+        d = self.dentries.get((parent, name))
+        if d is None:
+            raise NoEntry(f"{name!r} in {parent}")
+        return d
+
+    def read_dir(self, parent: int) -> list[Dentry]:
+        self._get_inode(parent)
+        return sorted(self.children.get(parent, {}).values(), key=lambda d: d.name)
+
+    def owns_ino(self, ino: int) -> bool:
+        return self.start <= ino < self.end

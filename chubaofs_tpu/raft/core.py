@@ -1,0 +1,346 @@
+"""RaftCore — a deterministic, message-passing raft consensus core.
+
+Reference counterpart: depends/tiglabs/raft (statemachine.go:23-30, server.go:65)
+— the multi-raft engine under master, metanode, and datanode random-writes.
+Design follows the etcd/tiglabs shape: a PURE state machine advanced by tick()
+and step(msg), emitting messages and committed entries through ready(). No
+threads, no clocks, no sockets in here — the server layer owns those — so every
+consensus scenario (elections, splits, log repair, snapshot install) is unit
+-testable deterministically, the way the reference tests multi-node logic with
+in-process fakes (SURVEY §4).
+
+Log model: 1-based indexes; entries list holds (term, payload) pairs starting at
+`offset + 1` (offset = index of the last snapshot-compacted entry).
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+ROLE_FOLLOWER = "follower"
+ROLE_CANDIDATE = "candidate"
+ROLE_LEADER = "leader"
+
+ELECTION_TICKS = 10  # randomized per-node in [E, 2E)
+HEARTBEAT_TICKS = 2
+
+
+class NotLeaderError(Exception):
+    def __init__(self, leader: int | None):
+        super().__init__(f"not leader; leader hint: {leader}")
+        self.leader = leader
+
+
+@dataclass
+class Entry:
+    term: int
+    data: object  # opaque command; None for leader no-op barriers
+
+
+@dataclass
+class Msg:
+    type: str  # vote_req | vote_resp | append | append_resp | snap
+    group: int
+    src: int
+    dst: int
+    term: int
+    # vote
+    last_log_index: int = 0
+    last_log_term: int = 0
+    granted: bool = False
+    # append
+    prev_index: int = 0
+    prev_term: int = 0
+    entries: list[Entry] = field(default_factory=list)
+    commit: int = 0
+    success: bool = False
+    match_index: int = 0
+    # snapshot
+    snap_index: int = 0
+    snap_term: int = 0
+    snap_data: bytes = b""
+
+
+class RaftCore:
+    def __init__(self, group: int, node_id: int, peers: list[int], rng: random.Random | None = None):
+        self.group = group
+        self.id = node_id
+        self.peers = [p for p in peers if p != node_id]
+        self.rng = rng or random.Random(node_id * 7919 + group)
+
+        # persistent state
+        self.term = 0
+        self.voted_for: int | None = None
+        self.offset = 0  # last compacted index
+        self.offset_term = 0
+        self.entries: list[Entry] = []
+
+        # volatile
+        self.role = ROLE_FOLLOWER
+        self.leader: int | None = None
+        self.commit = 0
+        self.applied = 0
+        self.votes: set[int] = set()
+        self.next_index: dict[int, int] = {}
+        self.match_index: dict[int, int] = {}
+        self.elapsed = 0
+        self.election_timeout = self._rand_timeout()
+
+        self._outbox: list[Msg] = []
+        self._committed: list[tuple[int, Entry]] = []
+        # set by the server when the sm can produce a snapshot for laggards
+        self.snapshot_fn = None  # () -> (index, term, bytes)
+
+    # -- helpers ------------------------------------------------------------
+
+    def _rand_timeout(self) -> int:
+        return ELECTION_TICKS + self.rng.randrange(ELECTION_TICKS)
+
+    @property
+    def last_index(self) -> int:
+        return self.offset + len(self.entries)
+
+    def term_at(self, index: int) -> int:
+        if index == self.offset:
+            return self.offset_term
+        if index < self.offset or index > self.last_index:
+            return -1
+        return self.entries[index - self.offset - 1].term
+
+    def entry_at(self, index: int) -> Entry:
+        return self.entries[index - self.offset - 1]
+
+    def _send(self, **kw):
+        self._outbox.append(Msg(group=self.group, src=self.id, **kw))
+
+    def quorum(self) -> int:
+        return (len(self.peers) + 1) // 2 + 1
+
+    # -- public: server drives these ----------------------------------------
+
+    def tick(self):
+        self.elapsed += 1
+        if self.role == ROLE_LEADER:
+            if self.elapsed >= HEARTBEAT_TICKS:
+                self.elapsed = 0
+                self._broadcast_append()
+        elif self.elapsed >= self.election_timeout:
+            self._campaign()
+
+    def propose(self, data) -> int:
+        if self.role != ROLE_LEADER:
+            raise NotLeaderError(self.leader)
+        self.entries.append(Entry(self.term, data))
+        index = self.last_index
+        self.match_index[self.id] = index
+        if not self.peers:  # single-node group commits immediately
+            self._advance_commit()
+        else:
+            self._broadcast_append()
+        return index
+
+    def step(self, m: Msg):
+        if m.term > self.term:
+            self._become_follower(m.term, m.src if m.type == "append" else None)
+        handler = getattr(self, "_on_" + m.type)
+        handler(m)
+
+    def ready(self) -> tuple[list[Msg], list[tuple[int, Entry]]]:
+        """Drain outgoing messages and newly committed entries."""
+        out, self._outbox = self._outbox, []
+        committed, self._committed = self._committed, []
+        return out, committed
+
+    def compact(self, index: int, term: int):
+        """Drop log entries <= index (after the server snapshots the SM)."""
+        if index <= self.offset:
+            return
+        keep = self.entries[index - self.offset :]
+        self.offset, self.offset_term, self.entries = index, term, keep
+
+    # -- roles ---------------------------------------------------------------
+
+    def _become_follower(self, term: int, leader: int | None):
+        self.term = term
+        self.role = ROLE_FOLLOWER
+        self.voted_for = None
+        self.leader = leader
+        self.votes.clear()
+        self.elapsed = 0
+        self.election_timeout = self._rand_timeout()
+
+    def _campaign(self):
+        self.role = ROLE_CANDIDATE
+        self.term += 1
+        self.voted_for = self.id
+        self.votes = {self.id}
+        self.leader = None
+        self.elapsed = 0
+        self.election_timeout = self._rand_timeout()
+        if not self.peers:
+            self._become_leader()
+            return
+        for p in self.peers:
+            self._send(
+                type="vote_req",
+                dst=p,
+                term=self.term,
+                last_log_index=self.last_index,
+                last_log_term=self.term_at(self.last_index),
+            )
+
+    def _become_leader(self):
+        self.role = ROLE_LEADER
+        self.leader = self.id
+        self.elapsed = 0
+        self.next_index = {p: self.last_index + 1 for p in self.peers}
+        self.match_index = {p: 0 for p in self.peers}
+        self.match_index[self.id] = self.last_index
+        # no-op barrier commits entries from prior terms (raft §5.4.2)
+        self.entries.append(Entry(self.term, None))
+        self.match_index[self.id] = self.last_index
+        if not self.peers:
+            self._advance_commit()
+        else:
+            self._broadcast_append()
+
+    # -- vote flow -----------------------------------------------------------
+
+    def _on_vote_req(self, m: Msg):
+        if m.term < self.term:
+            self._send(type="vote_resp", dst=m.src, term=self.term, granted=False)
+            return
+        up_to_date = (m.last_log_term, m.last_log_index) >= (
+            self.term_at(self.last_index),
+            self.last_index,
+        )
+        grant = up_to_date and self.voted_for in (None, m.src)
+        if grant:
+            self.voted_for = m.src
+            self.elapsed = 0
+        self._send(type="vote_resp", dst=m.src, term=self.term, granted=grant)
+
+    def _on_vote_resp(self, m: Msg):
+        if self.role != ROLE_CANDIDATE or m.term != self.term:
+            return
+        if m.granted:
+            self.votes.add(m.src)
+            if len(self.votes) >= self.quorum():
+                self._become_leader()
+
+    # -- replication ----------------------------------------------------------
+
+    def _broadcast_append(self):
+        for p in self.peers:
+            self._send_append(p)
+
+    def _send_append(self, peer: int):
+        next_i = self.next_index.get(peer, self.last_index + 1)
+        if next_i <= self.offset:
+            self._send_snapshot(peer)
+            return
+        prev = next_i - 1
+        ents = [self.entry_at(i) for i in range(next_i, self.last_index + 1)]
+        self._send(
+            type="append",
+            dst=peer,
+            term=self.term,
+            prev_index=prev,
+            prev_term=self.term_at(prev),
+            entries=ents,
+            commit=self.commit,
+        )
+
+    def _send_snapshot(self, peer: int):
+        if self.snapshot_fn is None:
+            return
+        idx, term, data = self.snapshot_fn()
+        self._send(
+            type="snap", dst=peer, term=self.term, snap_index=idx, snap_term=term, snap_data=data
+        )
+
+    def _on_append(self, m: Msg):
+        if m.term < self.term:
+            self._send(type="append_resp", dst=m.src, term=self.term, success=False)
+            return
+        self.role = ROLE_FOLLOWER
+        self.leader = m.src
+        self.elapsed = 0
+        if m.prev_index > self.last_index or self.term_at(m.prev_index) != m.prev_term:
+            self._send(
+                type="append_resp",
+                dst=m.src,
+                term=self.term,
+                success=False,
+                match_index=min(self.last_index, max(self.offset, m.prev_index - 1)),
+            )
+            return
+        # append, truncating conflicts
+        for i, ent in enumerate(m.entries):
+            idx = m.prev_index + 1 + i
+            if idx <= self.offset:
+                continue  # already compacted into a snapshot
+            if idx <= self.last_index and self.term_at(idx) == ent.term:
+                continue
+            self.entries = self.entries[: idx - self.offset - 1]
+            self.entries.append(ent)
+        if m.commit > self.commit:
+            self.commit = min(m.commit, self.last_index)
+            self._emit_committed()
+        self._send(
+            type="append_resp",
+            dst=m.src,
+            term=self.term,
+            success=True,
+            match_index=m.prev_index + len(m.entries),
+        )
+
+    def _on_append_resp(self, m: Msg):
+        if self.role != ROLE_LEADER or m.term != self.term:
+            return
+        if m.success:
+            self.match_index[m.src] = max(self.match_index.get(m.src, 0), m.match_index)
+            self.next_index[m.src] = self.match_index[m.src] + 1
+            self._advance_commit()
+        else:
+            hint = m.match_index if m.match_index > 0 else self.next_index.get(m.src, 2) - 2
+            self.next_index[m.src] = max(1, min(hint + 1, self.last_index + 1))
+            self._send_append(m.src)
+
+    def _advance_commit(self):
+        for idx in range(self.last_index, self.commit, -1):
+            if self.term_at(idx) != self.term:
+                break  # only commit entries of the current term by counting (§5.4.2)
+            votes = sum(
+                1 for p in [self.id, *self.peers] if self.match_index.get(p, 0) >= idx
+            )
+            if votes >= self.quorum():
+                self.commit = idx
+                self._emit_committed()
+                break
+
+    def _emit_committed(self):
+        while self.applied < self.commit:
+            self.applied += 1
+            if self.applied <= self.offset:
+                continue  # folded into a snapshot already
+            self._committed.append((self.applied, self.entry_at(self.applied)))
+
+    # -- snapshot install ------------------------------------------------------
+
+    def _on_snap(self, m: Msg):
+        if m.term < self.term:
+            return
+        self.role = ROLE_FOLLOWER
+        self.leader = m.src
+        self.elapsed = 0
+        if m.snap_index <= self.commit:
+            return  # stale snapshot
+        self.offset, self.offset_term = m.snap_index, m.snap_term
+        self.entries = []
+        self.commit = self.applied = m.snap_index
+        self._committed.append((m.snap_index, Entry(m.snap_term, ("__install_snapshot__", m.snap_data))))
+        self._send(
+            type="append_resp", dst=m.src, term=self.term, success=True, match_index=m.snap_index
+        )

@@ -1,0 +1,313 @@
+"""MultiRaft server — hosts many raft groups per node over one transport.
+
+Reference counterpart: raftstore/raftstore.go:34-41 (RaftStore facade),
+depends/tiglabs/raft/server.go:65 (NewRaftServer, many groups, merged
+heartbeats). One MultiRaft instance per node hosts every partition's group:
+master GroupID=1, one group per meta partition, one per random-write data
+partition — same multiplexing the reference uses.
+
+The transport is pluggable; InProcNet wires nodes in one process (the test
+strategy of SURVEY §4) and batches per-destination messages the way tiglabs
+merges heartbeats across groups. WAL persistence: term/vote + entries per
+group as JSONL; snapshots delegate to the StateMachine and compact the log.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import threading
+import time
+from concurrent.futures import Future
+
+from chubaofs_tpu.raft.core import Entry, Msg, NotLeaderError, RaftCore, ROLE_LEADER
+
+
+class StateMachine:
+    """What a replicated component implements (statemachine.go:23-30 analog)."""
+
+    def apply(self, data, index: int):  # -> result delivered to the proposer
+        raise NotImplementedError
+
+    def snapshot(self) -> bytes:
+        raise NotImplementedError
+
+    def restore(self, data: bytes) -> None:
+        raise NotImplementedError
+
+    def on_leader_change(self, leader: int | None) -> None:
+        pass
+
+
+class InProcNet:
+    """In-process transport connecting MultiRaft nodes; per-dst batching."""
+
+    def __init__(self):
+        self.nodes: dict[int, "MultiRaft"] = {}
+        self.partitions: set[frozenset] = set()  # simulated network partitions
+        self._lock = threading.Lock()
+
+    def register(self, node: "MultiRaft"):
+        with self._lock:
+            self.nodes[node.node_id] = node
+
+    def isolate(self, *node_ids: int):
+        """Cut node_ids off from everyone else (fault injection)."""
+        with self._lock:
+            self.partitions.add(frozenset(node_ids))
+
+    def heal(self):
+        with self._lock:
+            self.partitions.clear()
+
+    def _blocked(self, a: int, b: int) -> bool:
+        for part in self.partitions:
+            if (a in part) != (b in part):
+                return True
+        return False
+
+    def send(self, msgs: list[Msg]):
+        by_dst: dict[int, list[Msg]] = {}
+        for m in msgs:
+            if self._blocked(m.src, m.dst):
+                continue
+            by_dst.setdefault(m.dst, []).append(m)
+        for dst, batch in by_dst.items():
+            node = self.nodes.get(dst)
+            if node is not None:
+                node.deliver(batch)
+
+
+class _Group:
+    def __init__(self, core: RaftCore, sm: StateMachine, wal_path: str | None):
+        self.core = core
+        self.sm = sm
+        self.wal_path = wal_path
+        self.wal = None
+        self.waiters: dict[int, tuple[int, Future]] = {}  # index -> (term, future)
+        self.last_leader: int | None = None
+        if wal_path:
+            self._recover()
+            self.wal = open(wal_path, "a")
+
+    def _recover(self):
+        snap_path = self.wal_path + ".snap"
+        if os.path.exists(snap_path):
+            with open(snap_path, "rb") as f:
+                meta_len = int.from_bytes(f.read(4), "little")
+                meta = json.loads(f.read(meta_len))
+                payload = f.read()
+            self.sm.restore(payload)
+            self.core.offset = meta["index"]
+            self.core.offset_term = meta["term"]
+            self.core.commit = self.core.applied = meta["index"]
+        if os.path.exists(self.wal_path):
+            with open(self.wal_path) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    rec = json.loads(line)
+                    if rec[0] == "hs":  # hard state
+                        self.core.term, self.core.voted_for = rec[1], rec[2]
+                    elif rec[0] == "ent":
+                        idx, term, blob = rec[1], rec[2], rec[3]
+                        if idx <= self.core.offset:
+                            continue
+                        # truncate conflicts, then append
+                        self.core.entries = self.core.entries[: idx - self.core.offset - 1]
+                        data = pickle.loads(bytes.fromhex(blob)) if blob else None
+                        self.core.entries.append(Entry(term, data))
+                    elif rec[0] == "commit":
+                        idx = min(rec[1], self.core.last_index)
+                        self.core.commit = max(self.core.commit, idx)
+            # replay committed entries into the SM
+            for idx in range(self.core.offset + 1, self.core.commit + 1):
+                ent = self.core.entry_at(idx)
+                if ent.data is not None:
+                    self.sm.apply(ent.data, idx)
+            self.core.applied = self.core.commit
+
+    def persist(self, hard_state_changed: bool, new_entries: list[tuple[int, Entry]], commit: int):
+        if not self.wal:
+            return
+        if hard_state_changed:
+            self.wal.write(json.dumps(["hs", self.core.term, self.core.voted_for]) + "\n")
+        for idx, ent in new_entries:
+            blob = pickle.dumps(ent.data).hex() if ent.data is not None else ""
+            self.wal.write(json.dumps(["ent", idx, ent.term, blob]) + "\n")
+        self.wal.write(json.dumps(["commit", commit]) + "\n")
+        self.wal.flush()
+
+    def take_snapshot(self):
+        """Snapshot the SM at applied index and compact the log."""
+        if not self.wal_path:
+            self.core.compact(self.core.applied, self.core.term_at(self.core.applied))
+            return
+        idx = self.core.applied
+        term = self.core.term_at(idx)
+        payload = self.sm.snapshot()
+        meta = json.dumps({"index": idx, "term": term}).encode()
+        tmp = self.wal_path + ".snap.tmp"
+        with open(tmp, "wb") as f:
+            f.write(len(meta).to_bytes(4, "little") + meta + payload)
+        os.replace(tmp, self.wal_path + ".snap")
+        self.core.compact(idx, term)
+        self.wal.close()
+        self.wal = open(self.wal_path, "w")
+        self.wal.write(json.dumps(["hs", self.core.term, self.core.voted_for]) + "\n")
+        for i in range(self.core.offset + 1, self.core.last_index + 1):
+            ent = self.core.entry_at(i)
+            blob = pickle.dumps(ent.data).hex() if ent.data is not None else ""
+            self.wal.write(json.dumps(["ent", i, ent.term, blob]) + "\n")
+        self.wal.write(json.dumps(["commit", self.core.commit]) + "\n")
+        self.wal.flush()
+
+
+class MultiRaft:
+    """All raft groups of one node + the tick/apply pump."""
+
+    def __init__(self, node_id: int, net: InProcNet, wal_dir: str | None = None,
+                 snapshot_every: int = 0):
+        self.node_id = node_id
+        self.net = net
+        self.wal_dir = wal_dir
+        self.snapshot_every = snapshot_every
+        self.groups: dict[int, _Group] = {}
+        self._lock = threading.RLock()
+        net.register(self)
+
+    # -- group lifecycle -----------------------------------------------------
+
+    def create_group(self, group_id: int, peers: list[int], sm: StateMachine) -> None:
+        with self._lock:
+            core = RaftCore(group_id, self.node_id, peers)
+            wal_path = None
+            if self.wal_dir:
+                os.makedirs(self.wal_dir, exist_ok=True)
+                wal_path = os.path.join(self.wal_dir, f"g{group_id}.wal")
+            g = _Group(core, sm, wal_path)
+
+            def snap_fn():
+                payload = sm.snapshot()
+                return core.applied, core.term_at(core.applied), payload
+
+            core.snapshot_fn = snap_fn
+            self.groups[group_id] = g
+
+    def remove_group(self, group_id: int) -> None:
+        with self._lock:
+            self.groups.pop(group_id, None)
+
+    def is_leader(self, group_id: int) -> bool:
+        g = self.groups.get(group_id)
+        return g is not None and g.core.role == ROLE_LEADER
+
+    def leader_of(self, group_id: int) -> int | None:
+        g = self.groups.get(group_id)
+        return g.core.leader if g else None
+
+    # -- the pump ------------------------------------------------------------
+
+    def tick(self):
+        """One logical clock tick for every group; flush I/O."""
+        with self._lock:
+            for g in self.groups.values():
+                term0, vote0 = g.core.term, g.core.voted_for
+                last0, commit0 = g.core.last_index, g.core.commit
+                g.core.tick()
+                self._flush(g, term0, vote0, last0, commit0)
+
+    def deliver(self, msgs: list[Msg]):
+        with self._lock:
+            for m in msgs:
+                g = self.groups.get(m.group)
+                if g is None:
+                    continue
+                term0, vote0 = g.core.term, g.core.voted_for
+                last0, commit0 = g.core.last_index, g.core.commit
+                g.core.step(m)
+                self._flush(g, term0, vote0, last0, commit0)
+
+    def _flush(self, g: _Group, term0: int, vote0, last0: int, commit0: int):
+        core = g.core
+        msgs, committed = core.ready()
+        new_entries = [
+            (i, core.entry_at(i))
+            for i in range(max(last0, core.offset) + 1, core.last_index + 1)
+        ]
+        hs_changed = core.term != term0 or core.voted_for != vote0
+        if hs_changed or new_entries or core.commit != commit0:
+            g.persist(hs_changed, new_entries, core.commit)
+        for idx, ent in committed:
+            if isinstance(ent.data, tuple) and len(ent.data) == 2 and ent.data[0] == "__install_snapshot__":
+                g.sm.restore(ent.data[1])
+                continue
+            result = g.sm.apply(ent.data, idx) if ent.data is not None else None
+            waiter = g.waiters.pop(idx, None)
+            if waiter:
+                wterm, fut = waiter
+                if ent.term == wterm:
+                    fut.set_result(result)
+                else:
+                    fut.set_exception(NotLeaderError(core.leader))
+        if g.last_leader != core.leader:
+            g.last_leader = core.leader
+            g.sm.on_leader_change(core.leader)
+        if (
+            self.snapshot_every
+            and core.applied - core.offset >= self.snapshot_every
+        ):
+            g.take_snapshot()
+        if msgs:
+            self.net.send(msgs)
+
+    # -- client API ------------------------------------------------------------
+
+    def propose(self, group_id: int, data) -> Future:
+        """Replicate one command; future resolves with sm.apply's result."""
+        with self._lock:
+            g = self.groups.get(group_id)
+            if g is None:
+                raise KeyError(f"no group {group_id} on node {self.node_id}")
+            last0, commit0 = g.core.last_index, g.core.commit
+            idx = g.core.propose(data)  # raises NotLeaderError when follower
+            fut: Future = Future()
+            g.waiters[idx] = (g.core.term, fut)
+            self._flush(g, g.core.term, g.core.voted_for, last0, commit0)
+            return fut
+
+
+def run_until(net: InProcNet, cond, max_ticks: int = 300, sleep: float = 0.0) -> bool:
+    """Drive every node's clock until cond() or tick budget exhausted (tests)."""
+    for _ in range(max_ticks):
+        for node in list(net.nodes.values()):
+            node.tick()
+        if cond():
+            return True
+        if sleep:
+            time.sleep(sleep)
+    return cond()
+
+
+class TickLoop:
+    """Background wall-clock pump for live deployments (100ms/tick default)."""
+
+    def __init__(self, nodes: list[MultiRaft], interval: float = 0.1):
+        self.nodes = nodes
+        self.interval = interval
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, daemon=True, name="raft-tick")
+
+    def start(self):
+        self._thread.start()
+
+    def _run(self):
+        while not self._stop.is_set():
+            for n in self.nodes:
+                n.tick()
+            self._stop.wait(self.interval)
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2)

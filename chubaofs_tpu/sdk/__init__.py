@@ -1,0 +1,6 @@
+"""Client SDKs: metadata wrapper, filesystem facade (reference sdk/ equivalent)."""
+
+from chubaofs_tpu.sdk.meta_wrapper import MetaWrapper
+from chubaofs_tpu.sdk.fs import FsClient, FsError
+
+__all__ = ["MetaWrapper", "FsClient", "FsError"]

@@ -1,0 +1,196 @@
+"""FsClient — the POSIX-ish filesystem facade over meta + data planes.
+
+Reference counterpart: the client-side verbs of libsdk/libsdk.go (cfs_open,
+cfs_read, cfs_write, cfs_mkdirs, ...) and client/fs (file.go Read/Write via the
+extent client, dir.go). Data placement follows the volume's tier:
+
+  * cold volumes write file data through the blobstore access gateway (EC on
+    TPU) and record the signed locations as obj_extents — the
+    sdk/data/blobstore writer.go:472 + ObjExtentKey flow;
+  * hot volumes write through the extent client to replicated datanodes
+    (chubaofs_tpu/data) and record ExtentKeys.
+
+Paths resolve component-by-component through MetaWrapper (the FUSE-side icache
+is a straightforward addition; kept out of the core verbs)."""
+
+from __future__ import annotations
+
+import stat as stat_mod
+
+from chubaofs_tpu.meta.metanode import OpError
+from chubaofs_tpu.meta.partition import ROOT_INO
+from chubaofs_tpu.sdk.meta_wrapper import MetaWrapper
+
+
+class FsError(Exception):
+    def __init__(self, code: str, msg: str = ""):
+        super().__init__(f"{code}: {msg}")
+        self.code = code
+
+
+class FsClient:
+    def __init__(self, meta: MetaWrapper, data_backend):
+        """data_backend implements write(data)->location_json and
+        read(location_json, offset, size)->bytes and delete(location_json)."""
+        self.meta = meta
+        self.data = data_backend
+
+    # -- path resolution --------------------------------------------------------
+
+    def resolve(self, path: str) -> int:
+        ino = ROOT_INO
+        for part in [p for p in path.split("/") if p]:
+            try:
+                d = self.meta.lookup(ino, part)
+            except OpError as e:
+                raise FsError(e.code, path) from None
+            ino = d.ino
+        return ino
+
+    def _resolve_parent(self, path: str) -> tuple[int, str]:
+        parts = [p for p in path.split("/") if p]
+        if not parts:
+            raise FsError("EINVAL", "root")
+        parent = ROOT_INO
+        for part in parts[:-1]:
+            parent = self.meta.lookup(parent, part).ino
+        return parent, parts[-1]
+
+    # -- directory verbs --------------------------------------------------------
+
+    def mkdir(self, path: str, mode: int = 0o755) -> int:
+        parent, name = self._resolve_parent(path)
+        inode = self.meta.create_inode(stat_mod.S_IFDIR | mode)
+        try:
+            self.meta.create_dentry(parent, name, inode.ino, inode.mode)
+        except OpError as e:
+            raise FsError(e.code, path) from None
+        return inode.ino
+
+    def readdir(self, path: str) -> list[str]:
+        try:
+            return [d.name for d in self.meta.read_dir(self.resolve(path))]
+        except OpError as e:
+            raise FsError(e.code, path) from None
+
+    def rmdir(self, path: str) -> None:
+        parent, name = self._resolve_parent(path)
+        try:
+            d = self.meta.lookup(parent, name)
+            if not stat_mod.S_ISDIR(d.mode):
+                raise FsError("ENOTDIR", path)
+            self.meta.delete_dentry(parent, name)
+        except OpError as e:
+            raise FsError(e.code, path) from None
+        self.meta.unlink_inode(d.ino)
+        self.meta.evict_inode(d.ino)
+
+    # -- file verbs --------------------------------------------------------------
+
+    def create(self, path: str, mode: int = 0o644) -> int:
+        parent, name = self._resolve_parent(path)
+        inode = self.meta.create_inode(stat_mod.S_IFREG | mode)
+        try:
+            self.meta.create_dentry(parent, name, inode.ino, inode.mode)
+        except OpError as e:
+            raise FsError(e.code, path) from None
+        return inode.ino
+
+    def write_file(self, path: str, data: bytes) -> int:
+        """Whole-file write (create-or-truncate), the common S3/batch shape."""
+        try:
+            ino = self.resolve(path)
+            self.meta.truncate(ino, 0)
+        except FsError:
+            ino = self.create(path)
+        if data:
+            loc = self.data.write(data)
+            self.meta.append_obj_extents(ino, [{"loc": loc, "size": len(data)}], len(data))
+        return ino
+
+    def append_file(self, path: str, data: bytes) -> int:
+        try:
+            ino = self.resolve(path)
+        except FsError:
+            ino = self.create(path)
+        if data:
+            inode = self.meta.get_inode(ino)
+            loc = self.data.write(data)
+            self.meta.append_obj_extents(
+                ino, [{"loc": loc, "size": len(data)}], inode.size + len(data)
+            )
+        return ino
+
+    def read_file(self, path: str, offset: int = 0, size: int | None = None) -> bytes:
+        try:
+            inode = self.meta.get_inode(self.resolve(path))
+        except OpError as e:
+            raise FsError(e.code, path) from None
+        if size is None:
+            size = inode.size - offset
+        size = max(0, min(size, inode.size - offset))
+        out = bytearray()
+        pos = 0
+        for ext in inode.obj_extents:
+            ext_size = ext["size"]
+            lo, hi = pos, pos + ext_size
+            pos = hi
+            if hi <= offset or lo >= offset + size:
+                continue
+            s = max(0, offset - lo)
+            e = min(ext_size, offset + size - lo)
+            out += self.data.read(ext["loc"], s, e - s)
+        return bytes(out)
+
+    def unlink(self, path: str) -> None:
+        parent, name = self._resolve_parent(path)
+        try:
+            d = self.meta.lookup(parent, name)
+            if stat_mod.S_ISDIR(d.mode):
+                raise FsError("EISDIR", path)
+            self.meta.delete_dentry(parent, name)
+        except OpError as e:
+            raise FsError(e.code, path) from None
+        self.meta.unlink_inode(d.ino)
+        self.meta.evict_inode(d.ino)
+
+    def rename(self, src: str, dst: str) -> None:
+        sp, sn = self._resolve_parent(src)
+        dp, dn = self._resolve_parent(dst)
+        try:
+            self.meta.rename(sp, sn, dp, dn)
+        except OpError as e:
+            raise FsError(e.code, f"{src} -> {dst}") from None
+
+    def stat(self, path: str) -> dict:
+        try:
+            inode = self.meta.get_inode(self.resolve(path))
+        except OpError as e:
+            raise FsError(e.code, path) from None
+        return {
+            "ino": inode.ino,
+            "mode": inode.mode,
+            "size": inode.size,
+            "nlink": inode.nlink,
+            "uid": inode.uid,
+            "gid": inode.gid,
+            "mtime": inode.mtime,
+            "is_dir": inode.is_dir,
+        }
+
+    def link(self, existing: str, new: str) -> None:
+        ino = self.resolve(existing)
+        parent, name = self._resolve_parent(new)
+        try:
+            self.meta.link(parent, name, ino)
+        except OpError as e:
+            raise FsError(e.code, new) from None
+
+    def setxattr(self, path: str, key: str, value: bytes) -> None:
+        self.meta.set_xattr(self.resolve(path), key, value)
+
+    def getxattr(self, path: str, key: str) -> bytes:
+        inode = self.meta.get_inode(self.resolve(path))
+        if key not in inode.xattrs:
+            raise FsError("ENODATA", key)
+        return inode.xattrs[key]

@@ -1,0 +1,142 @@
+"""MetaWrapper — volume-view routing + leader-retry metadata client.
+
+Reference counterpart: sdk/meta (meta.py:113-121 MetaWrapper with an
+inode-range btree, api.go Create_ll/Lookup_ll/InodeGet_ll, operation.go's
+retry/leader-switch). Routing: an inode belongs to the partition whose
+[start, end) contains it; new inodes are created on the TAIL partition (the one
+with the open range). Every op retries across the partition's peers until it
+finds the leader.
+"""
+
+from __future__ import annotations
+
+from chubaofs_tpu.master.master import MasterError, MetaPartitionView, VolumeView
+from chubaofs_tpu.meta.metanode import MetaNode, OpError
+from chubaofs_tpu.raft.server import NotLeaderError
+
+
+class MetaWrapper:
+    def __init__(self, master, metanodes: dict[int, MetaNode], volume: str):
+        self.master = master
+        self.metanodes = metanodes
+        self.volume = volume
+
+    # -- routing ---------------------------------------------------------------
+
+    def _view(self) -> VolumeView:
+        return self.master.get_volume(self.volume)
+
+    def partition_of(self, ino: int) -> MetaPartitionView:
+        for mp in self._view().meta_partitions:
+            if mp.start <= ino < mp.end:
+                return mp
+        raise MasterError(f"no partition owns inode {ino}")
+
+    def tail_partition(self) -> MetaPartitionView:
+        return self._view().meta_partitions[-1]
+
+    # -- leader-retry op execution ---------------------------------------------
+
+    def _on_partition(self, mp: MetaPartitionView, fn):
+        """Run fn(metanode) on the partition's leader, retrying peers."""
+        order = [mp.leader] if mp.leader in mp.peers else []
+        order += [p for p in mp.peers if p not in order]
+        last: Exception | None = None
+        for peer in order:
+            node = self.metanodes.get(peer)
+            if node is None:
+                continue
+            try:
+                return fn(node)
+            except NotLeaderError as e:
+                last = e
+                if e.leader in mp.peers and e.leader != peer:
+                    try:
+                        return fn(self.metanodes[e.leader])
+                    except NotLeaderError as e2:
+                        last = e2
+        raise last or MasterError(f"partition {mp.partition_id}: no leader reachable")
+
+    def submit(self, mp: MetaPartitionView, op: str, **args):
+        return self._on_partition(
+            mp, lambda node: node.submit_sync(mp.partition_id, op, **args)
+        )
+
+    # -- the ll API (api.go analogs) -------------------------------------------
+
+    def create_inode(self, mode: int, uid: int = 0, gid: int = 0):
+        mp = self.tail_partition()
+        return self._on_partition(
+            mp, lambda n: n.submit_sync(mp.partition_id, "create_inode", mode=mode, uid=uid, gid=gid)
+        )
+
+    def create_dentry(self, parent: int, name: str, ino: int, mode: int):
+        mp = self.partition_of(parent)
+        return self.submit(mp, "create_dentry", parent=parent, name=name, ino=ino, mode=mode)
+
+    def lookup(self, parent: int, name: str):
+        mp = self.partition_of(parent)
+        return self._on_partition(mp, lambda n: n.lookup(mp.partition_id, parent, name))
+
+    def get_inode(self, ino: int):
+        mp = self.partition_of(ino)
+        return self._on_partition(mp, lambda n: n.get_inode(mp.partition_id, ino))
+
+    def read_dir(self, parent: int):
+        mp = self.partition_of(parent)
+        return self._on_partition(mp, lambda n: n.read_dir(mp.partition_id, parent))
+
+    def delete_dentry(self, parent: int, name: str):
+        mp = self.partition_of(parent)
+        return self.submit(mp, "delete_dentry", parent=parent, name=name)
+
+    def unlink_inode(self, ino: int):
+        mp = self.partition_of(ino)
+        return self.submit(mp, "unlink_inode", ino=ino)
+
+    def evict_inode(self, ino: int):
+        mp = self.partition_of(ino)
+        return self.submit(mp, "evict_inode", ino=ino)
+
+    def update_inode(self, ino: int, **kw):
+        mp = self.partition_of(ino)
+        return self.submit(mp, "update_inode", ino=ino, **kw)
+
+    def truncate(self, ino: int, size: int):
+        mp = self.partition_of(ino)
+        return self.submit(mp, "truncate", ino=ino, size=size)
+
+    def append_extents(self, ino: int, extents: list[dict], size: int):
+        mp = self.partition_of(ino)
+        return self.submit(mp, "append_extents", ino=ino, extents=extents, size=size)
+
+    def append_obj_extents(self, ino: int, locations: list[dict], size: int):
+        mp = self.partition_of(ino)
+        return self.submit(mp, "append_obj_extents", ino=ino, locations=locations, size=size)
+
+    def rename(self, src_parent: int, src_name: str, dst_parent: int, dst_name: str):
+        src_mp = self.partition_of(src_parent)
+        dst_mp = self.partition_of(dst_parent)
+        if src_mp.partition_id == dst_mp.partition_id:
+            return self.submit(
+                src_mp, "rename_local", src_parent=src_parent, src_name=src_name,
+                dst_parent=dst_parent, dst_name=dst_name,
+            )
+        # cross-partition: create-then-delete (the reference's non-txn fallback;
+        # its transaction framework arrives with the txn layer)
+        d = self._on_partition(src_mp, lambda n: n.lookup(src_mp.partition_id, src_parent, src_name))
+        self.submit(dst_mp, "create_dentry", parent=dst_parent, name=dst_name, ino=d.ino, mode=d.mode)
+        try:
+            return self.submit(src_mp, "delete_dentry", parent=src_parent, name=src_name)
+        except OpError:
+            # undo on failure
+            self.submit(dst_mp, "delete_dentry", parent=dst_parent, name=dst_name)
+            raise
+
+    def link(self, parent: int, name: str, ino: int):
+        mp = self.partition_of(parent)
+        return self.submit(mp, "link", parent=parent, name=name, ino=ino)
+
+    def set_xattr(self, ino: int, key: str, value: bytes):
+        mp = self.partition_of(ino)
+        return self.submit(mp, "set_xattr", ino=ino, key=key, value=value)

@@ -302,3 +302,64 @@ def test_checkpoint_wal_rotation(tmp_path):
     cm2 = ClusterMgr(str(tmp_path / "cm"))
     first, _ = cm2.alloc_scope("bid", 1)
     assert first == 6  # 5 allocated exactly once, not replayed twice
+
+
+def test_volume_rotation_on_full_chunks(tmp_path, rng):
+    """Full chunks retire the volume and PUT rotates to a fresh one."""
+    c = MiniCluster(str(tmp_path), n_nodes=6, disks_per_node=2)
+    try:
+        # shrink chunks so a few puts fill them
+        for node in c.nodes.values():
+            for disk in node.disks.values():
+                disk.chunk_size = 300_000
+        locs = []
+        for i in range(6):  # each blob ~67KB/shard + framing; 300KB chunks hold 4
+            data = blob_bytes(rng, 400_000)
+            locs.append((c.access.put(data, code_mode=CodeMode.EC6P3), data))
+        vids = {loc.blobs[0].vid for loc, _ in locs}
+        assert len(vids) >= 2, "must have rotated to a second volume"
+        for loc, data in locs:
+            assert c.access.get(loc) == data
+    finally:
+        c.close()
+
+
+def test_failed_disk_repair_retried_after_failure(cluster, rng):
+    """A disk-repair task that exhausts retries is re-created while the disk
+    stays broken (no permanent under-replication)."""
+    from chubaofs_tpu.blobstore import scheduler as sched_mod
+
+    data = blob_bytes(rng, 500_000)
+    loc = cluster.access.put(data, code_mode=CodeMode.EC6P3)
+    vol = cluster.cm.get_volume(loc.blobs[0].vid)
+    victim_disk = vol.units[0].disk_id
+    cluster.cm.set_disk_status(victim_disk, DISK_BROKEN)
+
+    # poison the worker so every attempt fails
+    orig = cluster.worker._migrate_disk
+    cluster.worker._migrate_disk = lambda task: (_ for _ in ()).throw(RuntimeError("net down"))
+    for _ in range(4):
+        cluster.run_background_once()
+    failed = [t for t in cluster.scheduler.tasks(sched_mod.KIND_DISK_REPAIR)
+              if t.state == sched_mod.TASK_FAILED]
+    assert failed and "net down" in failed[0].error
+
+    # heal the worker: a new task is created and succeeds
+    cluster.worker._migrate_disk = orig
+    cluster.run_background_once()
+    cluster.run_background_once()
+    fresh = cluster.cm.get_volume(loc.blobs[0].vid)
+    assert fresh.units[0].disk_id != victim_disk
+    assert cluster.access.get(loc) == data
+
+
+def test_poisoned_task_does_not_stall_background(cluster, rng):
+    """An unrecoverable stripe fails its task; deletes still run that tick."""
+    data = blob_bytes(rng, 300_000)
+    loc = cluster.access.put(data, code_mode=CodeMode.EC6P3)
+    # fabricate a repair message for a stripe that cannot be gathered
+    cluster.proxy.send_shard_repair(loc.blobs[0].vid, 999999, [0], "bogus")
+    loc2 = cluster.access.put(blob_bytes(rng, 1000))
+    cluster.access.delete(loc2)
+    stats = cluster.run_background_once()
+    assert stats["deletes"] == 1  # deleter ran despite the poisoned repair task

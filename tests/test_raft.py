@@ -1,0 +1,194 @@
+"""Raft consensus: elections, replication, failures, snapshots, multi-group."""
+
+import pytest
+
+from chubaofs_tpu.raft import MultiRaft, InProcNet, NotLeaderError, StateMachine
+from chubaofs_tpu.raft.server import run_until
+
+
+class KvSM(StateMachine):
+    """Tiny replicated KV used as the test state machine."""
+
+    def __init__(self):
+        self.kv = {}
+        self.applied = []
+        self.leader_changes = []
+
+    def apply(self, data, index):
+        op, k, v = data
+        self.applied.append((index, data))
+        if op == "set":
+            self.kv[k] = v
+            return ("ok", k)
+        if op == "del":
+            return self.kv.pop(k, None)
+
+    def snapshot(self):
+        import json
+
+        return json.dumps(self.kv).encode()
+
+    def restore(self, payload):
+        import json
+
+        self.kv = json.loads(payload)
+
+    def on_leader_change(self, leader):
+        self.leader_changes.append(leader)
+
+
+def make_cluster(n=3, wal_root=None, snapshot_every=0):
+    net = InProcNet()
+    nodes, sms = {}, {}
+    for i in range(1, n + 1):
+        wal = f"{wal_root}/n{i}" if wal_root else None
+        nodes[i] = MultiRaft(i, net, wal_dir=wal, snapshot_every=snapshot_every)
+    for i in range(1, n + 1):
+        sms[i] = KvSM()
+        nodes[i].create_group(1, list(range(1, n + 1)), sms[i])
+    return net, nodes, sms
+
+
+def leader_id(nodes, group=1):
+    leaders = [i for i, n in nodes.items() if n.is_leader(group)]
+    return leaders[0] if len(leaders) == 1 else None
+
+
+def test_single_node_group_commits_immediately():
+    net = InProcNet()
+    node = MultiRaft(1, net)
+    sm = KvSM()
+    node.create_group(1, [1], sm)
+    assert run_until(net, lambda: node.is_leader(1))
+    fut = node.propose(1, ("set", "a", 1))
+    assert fut.result(timeout=1) == ("ok", "a")
+    assert sm.kv == {"a": 1}
+
+
+def test_election_and_replication():
+    net, nodes, sms = make_cluster(3)
+    assert run_until(net, lambda: leader_id(nodes) is not None)
+    lead = leader_id(nodes)
+    fut = nodes[lead].propose(1, ("set", "x", 42))
+    assert run_until(net, lambda: fut.done())
+    assert fut.result() == ("ok", "x")
+    assert run_until(net, lambda: all(s.kv.get("x") == 42 for s in sms.values()))
+
+
+def test_follower_propose_raises_not_leader():
+    net, nodes, _ = make_cluster(3)
+    assert run_until(net, lambda: leader_id(nodes) is not None)
+    lead = leader_id(nodes)
+    follower = next(i for i in nodes if i != lead)
+    with pytest.raises(NotLeaderError) as ei:
+        nodes[follower].propose(1, ("set", "y", 1))
+    assert ei.value.leader == lead
+
+
+def test_leader_failure_elects_new_and_preserves_log():
+    net, nodes, sms = make_cluster(3)
+    assert run_until(net, lambda: leader_id(nodes) is not None)
+    lead = leader_id(nodes)
+    fut = nodes[lead].propose(1, ("set", "k", "v"))
+    assert run_until(net, lambda: fut.done())
+
+    net.isolate(lead)  # old leader cut off
+    others = [i for i in nodes if i != lead]
+    assert run_until(
+        net, lambda: any(nodes[i].is_leader(1) for i in others), max_ticks=600
+    )
+    new_lead = next(i for i in others if nodes[i].is_leader(1))
+    f2 = nodes[new_lead].propose(1, ("set", "k2", "v2"))
+    assert run_until(net, lambda: f2.done())
+    assert sms[new_lead].kv == {"k": "v", "k2": "v2"}
+
+    # healed old leader catches up and steps down
+    net.heal()
+    assert run_until(
+        net,
+        lambda: sms[lead].kv.get("k2") == "v2" and not nodes[lead].is_leader(1),
+        max_ticks=600,
+    )
+
+
+def test_minority_partition_cannot_commit():
+    net, nodes, _ = make_cluster(3)
+    assert run_until(net, lambda: leader_id(nodes) is not None)
+    lead = leader_id(nodes)
+    net.isolate(lead)
+    for _ in range(30):
+        for n in nodes.values():
+            n.tick()
+    try:
+        fut = nodes[lead].propose(1, ("set", "ghost", 1))
+        for _ in range(100):
+            for n in nodes.values():
+                n.tick()
+        assert not fut.done() or isinstance(fut.exception(), NotLeaderError)
+    except NotLeaderError:
+        pass  # already stepped down
+
+
+def test_wal_recovery(tmp_path):
+    net, nodes, sms = make_cluster(3, wal_root=str(tmp_path))
+    assert run_until(net, lambda: leader_id(nodes) is not None)
+    lead = leader_id(nodes)
+    for i in range(5):
+        fut = nodes[lead].propose(1, ("set", f"k{i}", i))
+        assert run_until(net, lambda: fut.done())
+
+    # "restart" node: fresh MultiRaft over the same WAL dir
+    net2 = InProcNet()
+    n1 = MultiRaft(lead, net2, wal_dir=str(tmp_path / f"n{lead}"))
+    sm = KvSM()
+    n1.create_group(1, [1, 2, 3], sm)
+    assert sm.kv == {f"k{i}": i for i in range(5)}
+
+
+def test_snapshot_compaction_and_catchup(tmp_path):
+    net, nodes, sms = make_cluster(3, wal_root=str(tmp_path), snapshot_every=10)
+    assert run_until(net, lambda: leader_id(nodes) is not None)
+    lead = leader_id(nodes)
+
+    laggard = next(i for i in nodes if i != lead)
+    net.isolate(laggard)
+    for i in range(40):
+        fut = nodes[lead].propose(1, ("set", f"k{i}", i))
+        assert run_until(net, lambda: fut.done(), max_ticks=600)
+    # leader compacted beyond the laggard's log
+    assert nodes[lead].groups[1].core.offset > 0
+
+    net.heal()
+    assert run_until(
+        net, lambda: sms[laggard].kv.get("k39") == 39, max_ticks=900
+    ), "laggard must catch up via snapshot install"
+
+
+def test_many_groups_one_node():
+    """Multi-raft: 5 groups multiplexed over the same 3 nodes."""
+    net = InProcNet()
+    nodes = {i: MultiRaft(i, net) for i in (1, 2, 3)}
+    sms = {g: {} for g in range(1, 6)}
+    for g in range(1, 6):
+        for i in (1, 2, 3):
+            sm = KvSM()
+            sms[g][i] = sm
+            nodes[i].create_group(g, [1, 2, 3], sm)
+    assert run_until(
+        net,
+        lambda: all(leader_id(nodes, g) is not None for g in range(1, 6)),
+        max_ticks=600,
+    )
+    for g in range(1, 6):
+        lead = leader_id(nodes, g)
+        fut = nodes[lead].propose(g, ("set", "g", g))
+        assert run_until(net, lambda: fut.done())
+    for g in range(1, 6):
+        assert run_until(net, lambda: all(s.kv == {"g": g} for s in sms[g].values()))
+
+
+def test_leader_change_callback():
+    net, nodes, sms = make_cluster(3)
+    assert run_until(net, lambda: leader_id(nodes) is not None)
+    lead = leader_id(nodes)
+    assert sms[lead].leader_changes[-1] == lead
