@@ -1,0 +1,6 @@
+"""Replicated data plane: chain-repl + raft datanodes (datanode/, repl/)."""
+
+from chubaofs_tpu.data.datanode import (  # noqa: F401
+    DataNode, DataPartition, DataPartitionSM, SpaceManager,
+)
+from chubaofs_tpu.data.repl import FollowerAckError, ReplError, ReplServer  # noqa: F401

@@ -1,10 +1,12 @@
-"""FsCluster — a full in-process deployment: masters + metanodes + blobstore.
+"""FsCluster — a full in-process deployment: masters + metanodes + datanodes
++ blobstore.
 
 Reference analog: docker/docker-compose.yml's 3-master/4-metanode/4-datanode
 bring-up (SURVEY §4), collapsed into one process for tests and embedded use.
 Node layout: raft nodes 1..N each host the master group (GROUP 1) and any meta
-partition groups placed on them; file data rides the erasure-coded blobstore
-(cold-tier path) through the TPU codec service.
+partition groups placed on them; datanodes (ids 101..) run real TCP packet
+servers (chain replication + per-partition raft for random writes) for hot
+volumes; cold volumes ride the erasure-coded blobstore (TPU codec service).
 """
 
 from __future__ import annotations
@@ -12,11 +14,19 @@ from __future__ import annotations
 import os
 
 from chubaofs_tpu.blobstore.cluster import MiniCluster
+from chubaofs_tpu.data.datanode import DataNode
 from chubaofs_tpu.master.master import Master, MasterSM, MASTER_GROUP, MasterError
 from chubaofs_tpu.meta.metanode import MetaNode
+from chubaofs_tpu.proto.packet import (
+    OP_CREATE_PARTITION, Packet, RES_OK, recv_packet, send_packet,
+)
 from chubaofs_tpu.raft.server import InProcNet, MultiRaft, NotLeaderError, run_until
 from chubaofs_tpu.sdk.fs import FsClient
 from chubaofs_tpu.sdk.meta_wrapper import MetaWrapper
+from chubaofs_tpu.sdk.stream import ExtentClient, HotBackend
+from chubaofs_tpu.utils.conn_pool import ConnPool
+
+DATANODE_ID_BASE = 100
 
 
 class BlobstoreBackend:
@@ -36,13 +46,16 @@ class BlobstoreBackend:
 
 
 class FsCluster:
-    def __init__(self, root: str, n_nodes: int = 3, blob_nodes: int = 9):
+    def __init__(self, root: str, n_nodes: int = 3, blob_nodes: int = 9,
+                 data_nodes: int = 4, disks_per_datanode: int = 2):
         self.root = root
         self.net = InProcNet()
         self.rafts: dict[int, MultiRaft] = {}
         self.master_sms: dict[int, MasterSM] = {}
         self.masters: dict[int, Master] = {}
         self.metanodes: dict[int, MetaNode] = {}
+        self.datanodes: dict[int, DataNode] = {}
+        self.admin_pool = ConnPool()
 
         for i in range(1, n_nodes + 1):
             raft = MultiRaft(i, self.net, wal_dir=os.path.join(root, f"raft{i}"),
@@ -56,6 +69,19 @@ class FsCluster:
 
         for i, m in self.masters.items():
             m.metanode_hook = self._create_meta_partition
+            m.datanode_hook = self._create_data_partition
+
+        for j in range(1, data_nodes + 1):
+            nid = DATANODE_ID_BASE + j
+            draft = MultiRaft(nid, self.net,
+                              wal_dir=os.path.join(root, f"raft{nid}"),
+                              snapshot_every=512)
+            self.rafts[nid] = draft
+            disks = [os.path.join(root, f"dn{nid}", f"disk{k}")
+                     for k in range(disks_per_datanode)]
+            dn = DataNode(nid, "127.0.0.1:0", disks, raft=draft)
+            dn.start()
+            self.datanodes[nid] = dn
 
         self.blobstore = MiniCluster(os.path.join(root, "blob"), n_nodes=blob_nodes,
                                      disks_per_node=2)
@@ -65,11 +91,21 @@ class FsCluster:
         lead = self.master()
         for i in self.metanodes:
             lead.register_node(i, "meta")
-        # restart path: re-host every meta partition recorded in the recovered
-        # master state; each group's WAL/snapshot replays its namespace
+        for nid, dn in self.datanodes.items():
+            lead.register_node(nid, "data", addr=dn.addr)
+        # restart path: re-host every partition recorded in the recovered
+        # master state; datanode addresses changed, so re-resolve dp hosts
+        # from the fresh registry before reconnecting
+        lead.refresh_dp_hosts()
         for vol in list(lead.sm.volumes.values()):
             for mp in vol.meta_partitions:
                 self._create_meta_partition(mp.partition_id, mp.start, mp.end, mp.peers)
+            for dp in vol.data_partitions:
+                self._create_data_partition(dp.partition_id, dp.peers, dp.hosts)
+        self._purge_ec = None
+        for mn in self.metanodes.values():
+            mn.data_purge_hook = self._purge_inode_data
+            mn.extent_purge_hook = self._purge_extent_entry
 
     # -- pumping -----------------------------------------------------------------
 
@@ -89,6 +125,17 @@ class FsCluster:
             mn.drain_freelists()
         self.blobstore.run_background_once()
 
+    def repair_data_partitions(self) -> int:
+        """Leader-driven extent repair sweep (the 60s loop of
+        datanode/data_partition_repair.go:80); returns bytes streamed."""
+        moved = 0
+        for vol in self.master().sm.volumes.values():
+            for dp in vol.data_partitions:
+                leader = self._datanode_at(dp.hosts[0])
+                if leader is not None:
+                    moved += leader.repair_partition(dp.partition_id)
+        return moved
+
     # -- components ----------------------------------------------------------------
 
     def master(self) -> Master:
@@ -97,10 +144,60 @@ class FsCluster:
                 return m
         raise MasterError("no master leader")
 
+    def _datanode_at(self, addr: str) -> DataNode | None:
+        return next((d for d in self.datanodes.values() if d.addr == addr), None)
+
     def _create_meta_partition(self, pid: int, start: int, end: int, peers: list[int]):
         for peer in peers:
             self.metanodes[peer].create_partition(pid, start, end, peers)
         self.settle(lambda: any(self.rafts[p].is_leader(pid) for p in peers))
+
+    def _create_data_partition(self, pid: int, peers: list[int], hosts: list[str]):
+        """Admin task to every replica host (master/cluster_task.go analog),
+        over the real wire."""
+        for addr in hosts:
+            sock = self.admin_pool.get(addr)
+            try:
+                send_packet(sock, Packet(OP_CREATE_PARTITION, partition_id=pid,
+                                         arg={"peers": peers, "hosts": hosts}))
+                rep = recv_packet(sock)
+            except (OSError, ConnectionError):
+                self.admin_pool.put(addr, sock, ok=False)
+                raise
+            self.admin_pool.put(addr, sock)
+            if rep.result != RES_OK:
+                raise MasterError(f"create dp {pid} on {addr}: {rep.error()}")
+        self.settle(lambda: any(self.rafts[p].is_leader(pid) for p in peers))
+
+    def _purge_client(self) -> ExtentClient:
+        """One ExtentClient over every volume's partition table (purge path)."""
+        if self._purge_ec is None:
+            def all_views():
+                views = []
+                for vol_name in list(self.master().sm.volumes):
+                    views += self.master().data_partition_views(vol_name)
+                return views
+
+            self._purge_ec = ExtentClient(all_views)
+        self._purge_ec.refresh()
+        return self._purge_ec
+
+    def _purge_inode_data(self, inode) -> None:
+        """Freelist purge: blobstore locations + replica extents. Raises on
+        failure — the metanode keeps the orphan queued and retries."""
+        for ext in getattr(inode, "obj_extents", []):
+            self.data_backend.delete(ext["loc"])
+        keys = getattr(inode, "extents", [])
+        if keys:
+            self._purge_client().delete_extents(keys)
+
+    def _purge_extent_entry(self, entry: dict) -> None:
+        """Truncate-dropped spans (the metanode EXTENT_DEL drain)."""
+        for ext in entry.get("obj_extents", []):
+            self.data_backend.delete(ext["loc"])
+        keys = entry.get("extents", [])
+        if keys:
+            self._purge_client().delete_extents(keys)
 
     # -- volumes ---------------------------------------------------------------------
 
@@ -109,7 +206,15 @@ class FsCluster:
 
     def client(self, volume: str) -> FsClient:
         meta = MetaWrapper(self.master(), self.metanodes, volume)
-        return FsClient(meta, self.data_backend)
+        vol = self.master().get_volume(volume)
+        if vol.cold:
+            return FsClient(meta, self.data_backend, cold=True)
+        ec = ExtentClient(lambda: self.master().data_partition_views(volume))
+        return FsClient(meta, self.data_backend, hot_backend=HotBackend(ec, meta),
+                        cold=False)
 
     def close(self):
+        for dn in self.datanodes.values():
+            dn.stop()
+        self.admin_pool.close()
         self.blobstore.close()

@@ -50,6 +50,18 @@ class MetaPartitionView:
 
 
 @dataclass
+class DataPartitionView:
+    """One replicated data partition (master/data_partition.go analog):
+    peers are datanode ids (raft membership), hosts their repl addresses;
+    hosts[0] is the chain-replication leader."""
+
+    partition_id: int
+    peers: list[int] = field(default_factory=list)
+    hosts: list[str] = field(default_factory=list)
+    status: str = "rw"  # rw | ro | unavail
+
+
+@dataclass
 class VolumeView:
     name: str
     vol_id: int
@@ -57,6 +69,7 @@ class VolumeView:
     capacity: int = 0
     cold: bool = False  # cold volumes store data in the blobstore (EC tier)
     meta_partitions: list[MetaPartitionView] = field(default_factory=list)
+    data_partitions: list[DataPartitionView] = field(default_factory=list)
 
 
 class MasterSM(StateMachine):
@@ -95,7 +108,11 @@ class MasterSM(StateMachine):
     def _op_register_node(self, node_id: int, kind: str, addr: str):
         if node_id not in self.nodes:
             self.nodes[node_id] = NodeInfo(node_id, kind, addr)
-        self.nodes[node_id].last_heartbeat = time.time()
+        n = self.nodes[node_id]
+        n.kind = kind
+        if addr:  # re-registration after restart carries the new address
+            n.addr = addr
+        n.last_heartbeat = time.time()
         return node_id
 
     def _op_heartbeat(self, node_id: int, partition_count: int = 0, cursors: dict | None = None):
@@ -146,6 +163,38 @@ class MasterSM(StateMachine):
                 return None
         raise MasterError(f"unknown partition {partition_id}")
 
+    def _op_create_data_partition(self, vol_name: str, partition_id: int,
+                                  peers: list[int], hosts: list[str]):
+        vol = self.volumes.get(vol_name)
+        if vol is None:
+            raise MasterError(f"unknown volume {vol_name!r}")
+        vol.data_partitions.append(
+            DataPartitionView(partition_id, peers=peers, hosts=hosts))
+        for p in peers:
+            if p in self.nodes:
+                self.nodes[p].partition_count += 1
+        return vol.data_partitions[-1]
+
+    def _op_update_dp_hosts(self, vol_name: str, partition_id: int, hosts: list[str]):
+        vol = self.volumes.get(vol_name)
+        if vol is None:
+            raise MasterError(f"unknown volume {vol_name!r}")
+        for dp in vol.data_partitions:
+            if dp.partition_id == partition_id:
+                dp.hosts = hosts
+                return None
+        raise MasterError(f"unknown data partition {partition_id}")
+
+    def _op_set_dp_status(self, vol_name: str, partition_id: int, status: str):
+        vol = self.volumes.get(vol_name)
+        if vol is None:
+            raise MasterError(f"unknown volume {vol_name!r}")
+        for dp in vol.data_partitions:
+            if dp.partition_id == partition_id:
+                dp.status = status
+                return None
+        raise MasterError(f"unknown data partition {partition_id}")
+
     def _op_delete_volume(self, name: str):
         vol = self.volumes.pop(name, None)
         if vol is None:
@@ -165,6 +214,7 @@ class Master:
         self.raft = raft
         self.sm = sm
         self.metanode_hook = None  # (pid, start, end, peers) -> None
+        self.datanode_hook = None  # (pid, peers, hosts) -> None
 
     def _apply(self, op: str, **args):
         res = self.raft.propose(MASTER_GROUP, (op, args)).result(timeout=5)
@@ -196,8 +246,17 @@ class Master:
             raise MasterError(f"need {count} metanodes, have {len(metas)}")
         return [n.node_id for n in metas[:count]]
 
+    def _pick_data_peers(self, count: int = 3) -> list[NodeInfo]:
+        datas = sorted(
+            (n for n in self.sm.nodes.values() if n.kind == "data"),
+            key=lambda n: n.partition_count,
+        )
+        if len(datas) < count:
+            raise MasterError(f"need {count} datanodes, have {len(datas)}")
+        return datas[:count]
+
     def create_volume(self, name: str, owner: str = "", capacity: int = 1 << 40,
-                      cold: bool = False) -> VolumeView:
+                      cold: bool = False, data_partitions: int = 3) -> VolumeView:
         vol_id = self._apply("alloc_id")
         pid = self._apply("alloc_id")
         peers = self._pick_meta_peers()
@@ -207,7 +266,55 @@ class Master:
         )
         if self.metanode_hook:
             self.metanode_hook(pid, 1, INF, peers)
-        return vol
+        if not cold:
+            for _ in range(data_partitions):
+                self.create_data_partition(name)
+        return self.sm.volumes[name]
+
+    def create_data_partition(self, vol_name: str) -> DataPartitionView:
+        """Place one 3-replica data partition on the emptiest datanodes
+        (master/vol.go createDataPartition analog)."""
+        dp_id = self._apply("alloc_id")
+        nodes = self._pick_data_peers()
+        view = self._apply(
+            "create_data_partition", vol_name=vol_name, partition_id=dp_id,
+            peers=[n.node_id for n in nodes], hosts=[n.addr for n in nodes],
+        )
+        if self.datanode_hook:
+            self.datanode_hook(dp_id, view.peers, view.hosts)
+        return view
+
+    def _current_hosts(self, peers: list[int], stored: list[str]) -> list[str]:
+        """Resolve replica addresses from the live node registry; datanode
+        addresses change across restarts (ephemeral ports in tests)."""
+        out = []
+        for i, p in enumerate(peers):
+            n = self.sm.nodes.get(p)
+            out.append(n.addr if n and n.addr else (stored[i] if i < len(stored) else ""))
+        return out
+
+    def data_partition_views(self, vol_name: str) -> list[dict]:
+        """Client-facing partition table (the ExtentClient refresh feed)."""
+        vol = self.get_volume(vol_name)
+        return [
+            {"pid": dp.partition_id, "peers": list(dp.peers),
+             "hosts": self._current_hosts(dp.peers, dp.hosts)}
+            for dp in vol.data_partitions if dp.status == "rw"
+        ]
+
+    def refresh_dp_hosts(self) -> int:
+        """Re-resolve stored dp.hosts from the registry (restart path)."""
+        if not self.is_leader:
+            return 0
+        fixed = 0
+        for vol in list(self.sm.volumes.values()):
+            for dp in vol.data_partitions:
+                hosts = self._current_hosts(dp.peers, dp.hosts)
+                if hosts != dp.hosts:
+                    self._apply("update_dp_hosts", vol_name=vol.name,
+                                partition_id=dp.partition_id, hosts=hosts)
+                    fixed += 1
+        return fixed
 
     def get_volume(self, name: str) -> VolumeView:
         vol = self.sm.volumes.get(name)

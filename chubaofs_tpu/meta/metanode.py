@@ -27,8 +27,12 @@ class MetaNode:
         self.raft = raft
         self.partitions: dict[int, MetaPartitionSM] = {}
         self._lock = threading.Lock()
-        # injected by the deployment: called with (inode) to purge file data
+        # injected by the deployment: called with (inode) to purge file data;
+        # must RAISE on failure so the orphan stays queued and is retried
         self.data_purge_hook = None
+        # called with ({"extents": [...], "obj_extents": [...]}) for spans
+        # dropped by truncate; same raise-to-retry contract
+        self.extent_purge_hook = None
 
     # -- partition lifecycle (master drives this) ----------------------------
 
@@ -95,7 +99,11 @@ class MetaNode:
     # -- freelist delete loop (partition_free_list.go:180,233 analog) ----------
 
     def drain_freelists(self) -> int:
-        """Purge data of orphaned inodes on partitions this node leads."""
+        """Purge data of orphaned inodes + truncate-dropped extents on
+        partitions this node leads. Two-phase: drain peeks, the purge runs,
+        and only a successful purge acks the entry off the queue — so a
+        datanode/blobstore hiccup leaves it queued for the next sweep
+        (partition_free_list.go:180,233 retry discipline)."""
         purged = 0
         for pid in list(self.partitions):
             if not self.raft.is_leader(pid):
@@ -104,11 +112,37 @@ class MetaNode:
                 drained = self.submit_sync(pid, "drain_freelist")
             except (NotLeaderError, OpError):
                 continue
-            for ino in drained:
+            done = []
+            for inode in drained:
                 if self.data_purge_hook:
                     try:
-                        self.data_purge_hook(ino)
+                        self.data_purge_hook(inode)
                     except Exception:
-                        pass
-                purged += 1
+                        continue  # stays orphaned; retried next drain
+                done.append(inode.ino)
+            if done:
+                try:
+                    self.submit_sync(pid, "purge_ack", inos=done)
+                except (NotLeaderError, OpError):
+                    continue
+                purged += len(done)
+
+            try:
+                entries = self.submit_sync(pid, "drain_del_extents")
+            except (NotLeaderError, OpError):
+                continue
+            acked = []
+            for seq, entry in entries:
+                if self.extent_purge_hook:
+                    try:
+                        self.extent_purge_hook(entry)
+                    except Exception:
+                        continue
+                acked.append(seq)
+            if acked:
+                try:
+                    self.submit_sync(pid, "del_extents_ack", seqs=acked)
+                except (NotLeaderError, OpError):
+                    continue
+                purged += len(acked)
         return purged

@@ -103,6 +103,15 @@ class MetaPartitionSM(StateMachine):
         self.dentries: dict[tuple[int, str], Dentry] = {}
         self.children: dict[int, dict[str, Dentry]] = {}
         self.freelist: list[int] = []  # orphaned inos awaiting data cleanup
+        # evicted inode payloads keyed by ino: the drain needs the extent maps
+        # to purge data (partition_free_list.go keeps the inode until its
+        # extents are deleted)
+        self.orphans: dict[int, Inode] = {}
+        # extents dropped by truncate, awaiting datanode/blobstore deletion
+        # (the metanode EXTENT_DEL-file analog); entries are (seq, payload) and
+        # are removed only by an explicit ack after the purge succeeds
+        self.del_extents: list[tuple[int, dict]] = []
+        self.del_seq = 0
         self.multipart: dict[str, dict] = {}  # S3 multipart sessions
         self.uniq_seen: dict[int, int] = {}  # client_id -> last uniq id (idempotence)
         if start == ROOT_INO:
@@ -131,6 +140,9 @@ class MetaPartitionSM(StateMachine):
                 "inodes": self.inodes,
                 "dentries": self.dentries,
                 "freelist": self.freelist,
+                "orphans": self.orphans,
+                "del_extents": self.del_extents,
+                "del_seq": self.del_seq,
                 "multipart": self.multipart,
                 "uniq_seen": self.uniq_seen,
             }
@@ -143,6 +155,9 @@ class MetaPartitionSM(StateMachine):
         self.inodes = st["inodes"]
         self.dentries = st["dentries"]
         self.freelist = st["freelist"]
+        self.orphans = st.get("orphans", {})
+        self.del_extents = st.get("del_extents", [])
+        self.del_seq = st.get("del_seq", 0)
         self.multipart = st["multipart"]
         self.uniq_seen = st["uniq_seen"]
         self.children = {}
@@ -182,6 +197,7 @@ class MetaPartitionSM(StateMachine):
             del self.inodes[ino]
             if not inode.is_dir:
                 self.freelist.append(ino)
+                self.orphans[ino] = inode
         return None
 
     def _op_update_inode(self, ino: int, size: int | None = None, mode: int | None = None,
@@ -218,21 +234,30 @@ class MetaPartitionSM(StateMachine):
 
     def _op_truncate(self, ino: int, size: int):
         inode = self._get_inode(ino)
+        dropped = [e for e in inode.extents if e.file_offset >= size]
         inode.extents = [e for e in inode.extents if e.file_offset < size]
         for e in inode.extents:
             if e.file_offset + e.size > size:
                 e.size = size - e.file_offset
         # cold-tier map: obj extents are consecutive; keep those before the cut,
         # clip the one straddling it
-        kept, pos = [], 0
+        kept, dropped_obj, pos = [], [], 0
         for ext in inode.obj_extents:
             if pos >= size:
-                break
+                dropped_obj.append(ext)
+                pos += ext["size"]
+                continue
             if pos + ext["size"] > size:
                 ext = {**ext, "size": size - pos}
             kept.append(ext)
             pos += ext["size"]
         inode.obj_extents = kept
+        if dropped or dropped_obj:
+            self.del_seq += 1
+            self.del_extents.append((self.del_seq, {
+                "extents": [vars(e) for e in dropped],
+                "obj_extents": dropped_obj,
+            }))
         inode.size = size
         inode.mtime = time.time()
         return inode
@@ -298,8 +323,25 @@ class MetaPartitionSM(StateMachine):
     # -- fsm ops: freelist / multipart -----------------------------------------
 
     def _op_drain_freelist(self, max_items: int = 64):
-        drained, self.freelist = self.freelist[:max_items], self.freelist[max_items:]
-        return drained
+        """Peek orphaned inodes for purging. The orphan stays until the purge
+        acks (_op_purge_ack) — a failed purge is retried next drain."""
+        return [self.orphans[i] for i in self.freelist[:max_items]
+                if i in self.orphans]
+
+    def _op_purge_ack(self, inos: list[int]):
+        done = set(inos)
+        self.freelist = [i for i in self.freelist if i not in done]
+        for i in done:
+            self.orphans.pop(i, None)
+        return len(done)
+
+    def _op_drain_del_extents(self, max_items: int = 64):
+        return self.del_extents[:max_items]
+
+    def _op_del_extents_ack(self, seqs: list[int]):
+        done = set(seqs)
+        self.del_extents = [(s, e) for s, e in self.del_extents if s not in done]
+        return len(done)
 
     def _op_multipart_create(self, key: str, upload_id: str):
         self.multipart[upload_id] = {"key": key, "parts": {}}

@@ -1,0 +1,161 @@
+"""Wire protocol — the binary TCP packet every data-plane op rides.
+
+Reference counterpart: proto/packet.go:238-257 (the `Packet` struct: Magic,
+Opcode, ResultCode, RemainingFollowers, CRC, Size, ArgLen, PartitionID,
+ExtentID, ExtentOffset, ReqID, KernelOffset; opcodes :50-69). Design choices
+kept: fixed little-endian header followed by an opaque arg blob (JSON here,
+where the reference packs follower addresses as a '/'-joined string) and the
+data payload, CRC32 over the payload, and a RemainingFollowers byte that the
+chain-replication leader decrements before forwarding (packet.go:243,
+repl/repl_protocol.go:35-39). Not kept: the reference's ~150 opcodes collapse
+to the data-plane set below — metadata ops travel through raft proposals
+instead of this wire (metanode design in chubaofs_tpu/meta)."""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import zlib
+from dataclasses import dataclass, field
+
+MAGIC = 0xCF
+
+# -- opcodes (proto/packet.go:50-69 analog, data-plane subset) -----------------
+OP_CREATE_EXTENT = 0x01  # OpCreateExtent: alloc a normal extent id on the dp
+OP_WRITE = 0x02  # OpWrite: append to a normal or tiny extent
+OP_STREAM_READ = 0x03  # OpStreamRead: read [offset, offset+size) of an extent
+OP_RANDOM_WRITE = 0x04  # OpRandomWrite: in-place overwrite, routed via raft
+OP_MARK_DELETE = 0x05  # OpMarkDelete: extent (or tiny range) delete
+OP_GET_WATERMARKS = 0x06  # OpGetAllWatermarks: {extent_id: size} for repair
+OP_REPAIR_READ = 0x07  # OpExtentRepairRead: repair-path stream read
+OP_REPAIR_WRITE = 0x08  # repair-path write (bypasses replication; local only)
+OP_GET_PARTITION_METRICS = 0x09  # used + extent counts, for master heartbeats
+OP_HEARTBEAT = 0x0A  # liveness probe
+OP_CREATE_PARTITION = 0x0B  # admin: host a new data partition
+OP_TINY_DELETE_RECORD = 0x0C  # replicated tiny-range punch-hole record
+
+# -- result codes (proto/packet.go OpOk/OpErr/... analog) ----------------------
+RES_OK = 0x00
+RES_ERR = 0x01
+RES_AGAIN = 0x02
+RES_NOT_LEADER = 0x03
+RES_NOT_EXIST = 0x04
+RES_DISK_ERR = 0x05
+RES_CRC_MISMATCH = 0x06
+
+# magic, opcode, result, remaining_followers, crc, size, arg_len,
+# partition_id, extent_id, extent_offset, kernel_offset, req_id
+_HEADER = struct.Struct("<BBBBIIIQQQQQ")
+HEADER_SIZE = _HEADER.size  # 56 bytes
+
+TINY_EXTENT_COUNT = 64  # storage/extent_store.go:613-694: 64 shared tiny extents
+TINY_EXTENT_MAX_ID = TINY_EXTENT_COUNT  # ids 1..64 are tiny, >=65 normal
+
+
+def is_tiny_extent(extent_id: int) -> bool:
+    return 1 <= extent_id <= TINY_EXTENT_MAX_ID
+
+
+class ProtoError(Exception):
+    pass
+
+
+_req_counter = 0
+
+
+def next_req_id() -> int:
+    global _req_counter
+    _req_counter += 1
+    return _req_counter
+
+
+@dataclass
+class Packet:
+    opcode: int
+    partition_id: int = 0
+    extent_id: int = 0
+    extent_offset: int = 0
+    kernel_offset: int = 0
+    data: bytes = b""
+    arg: dict = field(default_factory=dict)
+    result: int = RES_OK
+    remaining_followers: int = 0
+    req_id: int = 0
+    crc: int = 0
+
+    def __post_init__(self):
+        if self.req_id == 0:
+            self.req_id = next_req_id()
+        if self.data and self.crc == 0:
+            self.crc = zlib.crc32(self.data)
+
+    # -- framing ---------------------------------------------------------------
+
+    def encode(self) -> bytes:
+        arg_blob = json.dumps(self.arg).encode() if self.arg else b""
+        hdr = _HEADER.pack(
+            MAGIC, self.opcode, self.result, self.remaining_followers,
+            self.crc, len(self.data), len(arg_blob),
+            self.partition_id, self.extent_id, self.extent_offset,
+            self.kernel_offset, self.req_id,
+        )
+        return hdr + arg_blob + self.data
+
+    @classmethod
+    def decode_header(cls, hdr: bytes) -> tuple["Packet", int, int]:
+        (magic, opcode, result, followers, crc, size, arg_len,
+         pid, eid, eoff, koff, req_id) = _HEADER.unpack(hdr)
+        if magic != MAGIC:
+            raise ProtoError(f"bad magic {magic:#x}")
+        pkt = cls(opcode=opcode, partition_id=pid, extent_id=eid,
+                  extent_offset=eoff, kernel_offset=koff, result=result,
+                  remaining_followers=followers, req_id=req_id, crc=crc)
+        return pkt, arg_len, size
+
+    def verify_crc(self) -> bool:
+        return not self.data or zlib.crc32(self.data) == self.crc
+
+    # -- replies ---------------------------------------------------------------
+
+    def reply(self, result: int = RES_OK, data: bytes = b"",
+              arg: dict | None = None, extent_id: int | None = None,
+              extent_offset: int | None = None) -> "Packet":
+        """Build the response packet mirroring ids; write acks may rewrite the
+        extent id/offset the datanode assigned (tiny-extent allocation)."""
+        return Packet(
+            opcode=self.opcode, partition_id=self.partition_id,
+            extent_id=self.extent_id if extent_id is None else extent_id,
+            extent_offset=self.extent_offset if extent_offset is None else extent_offset,
+            kernel_offset=self.kernel_offset, data=data, arg=arg or {},
+            result=result, req_id=self.req_id,
+        )
+
+    def error(self) -> str:
+        return self.arg.get("error", f"result={self.result}")
+
+
+# -- socket framing ---------------------------------------------------------------
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def send_packet(sock: socket.socket, pkt: Packet) -> None:
+    sock.sendall(pkt.encode())
+
+
+def recv_packet(sock: socket.socket) -> Packet:
+    pkt, arg_len, size = Packet.decode_header(_recv_exact(sock, HEADER_SIZE))
+    if arg_len:
+        pkt.arg = json.loads(_recv_exact(sock, arg_len))
+    if size:
+        pkt.data = _recv_exact(sock, size)
+    return pkt

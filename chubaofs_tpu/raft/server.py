@@ -210,15 +210,23 @@ class MultiRaft:
     # -- the pump ------------------------------------------------------------
 
     def tick(self):
-        """One logical clock tick for every group; flush I/O."""
+        """One logical clock tick for every group; flush I/O.
+
+        Outbound messages are sent AFTER the node lock is released: delivery
+        acquires the destination node's lock, and holding two node locks at
+        once would deadlock concurrent datanode/metanode handler threads."""
+        out: list[Msg] = []
         with self._lock:
             for g in self.groups.values():
                 term0, vote0 = g.core.term, g.core.voted_for
                 last0, commit0 = g.core.last_index, g.core.commit
                 g.core.tick()
-                self._flush(g, term0, vote0, last0, commit0)
+                out += self._flush(g, term0, vote0, last0, commit0)
+        if out:
+            self.net.send(out)
 
     def deliver(self, msgs: list[Msg]):
+        out: list[Msg] = []
         with self._lock:
             for m in msgs:
                 g = self.groups.get(m.group)
@@ -227,9 +235,11 @@ class MultiRaft:
                 term0, vote0 = g.core.term, g.core.voted_for
                 last0, commit0 = g.core.last_index, g.core.commit
                 g.core.step(m)
-                self._flush(g, term0, vote0, last0, commit0)
+                out += self._flush(g, term0, vote0, last0, commit0)
+        if out:
+            self.net.send(out)
 
-    def _flush(self, g: _Group, term0: int, vote0, last0: int, commit0: int):
+    def _flush(self, g: _Group, term0: int, vote0, last0: int, commit0: int) -> list[Msg]:
         core = g.core
         msgs, committed = core.ready()
         new_entries = [
@@ -259,8 +269,7 @@ class MultiRaft:
             and core.applied - core.offset >= self.snapshot_every
         ):
             g.take_snapshot()
-        if msgs:
-            self.net.send(msgs)
+        return msgs
 
     # -- client API ------------------------------------------------------------
 
@@ -274,8 +283,10 @@ class MultiRaft:
             idx = g.core.propose(data)  # raises NotLeaderError when follower
             fut: Future = Future()
             g.waiters[idx] = (g.core.term, fut)
-            self._flush(g, g.core.term, g.core.voted_for, last0, commit0)
-            return fut
+            out = self._flush(g, g.core.term, g.core.voted_for, last0, commit0)
+        if out:
+            self.net.send(out)
+        return fut
 
 
 def run_until(net: InProcNet, cond, max_ticks: int = 300, sleep: float = 0.0) -> bool:

@@ -29,11 +29,16 @@ class FsError(Exception):
 
 
 class FsClient:
-    def __init__(self, meta: MetaWrapper, data_backend):
-        """data_backend implements write(data)->location_json and
-        read(location_json, offset, size)->bytes and delete(location_json)."""
+    def __init__(self, meta: MetaWrapper, data_backend, hot_backend=None,
+                 cold: bool = True):
+        """Cold volumes: data_backend implements write(data)->location_json,
+        read(location_json, offset, size)->bytes, delete(location_json).
+        Hot volumes: hot_backend is a chubaofs_tpu.sdk.stream.HotBackend
+        (write(ino, offset, data), read(ino, offset, size), delete(ino, keys))."""
         self.meta = meta
         self.data = data_backend
+        self.hot = hot_backend
+        self.cold = cold or hot_backend is None
 
     # -- path resolution --------------------------------------------------------
 
@@ -104,8 +109,7 @@ class FsClient:
         except FsError:
             ino = self.create(path)
         if data:
-            loc = self.data.write(data)
-            self.meta.append_obj_extents(ino, [{"loc": loc, "size": len(data)}], len(data))
+            self.write_at(ino, 0, data)
         return ino
 
     def append_file(self, path: str, data: bytes) -> int:
@@ -114,12 +118,19 @@ class FsClient:
         except FsError:
             ino = self.create(path)
         if data:
-            inode = self.meta.get_inode(ino)
-            loc = self.data.write(data)
-            self.meta.append_obj_extents(
-                ino, [{"loc": loc, "size": len(data)}], inode.size + len(data)
-            )
+            self.write_at(ino, self.meta.get_inode(ino).size, data)
         return ino
+
+    def write_at(self, ino: int, offset: int, data: bytes) -> None:
+        """Positional write, tier-dispatched (file.go:367-439 Write analog)."""
+        if not self.cold:
+            self.hot.write(ino, offset, data)
+            return
+        if offset != self.meta.get_inode(ino).size:
+            raise FsError("EINVAL", "cold volumes are append-only")
+        loc = self.data.write(data)
+        self.meta.append_obj_extents(
+            ino, [{"loc": loc, "size": len(data)}], offset + len(data))
 
     def read_file(self, path: str, offset: int = 0, size: int | None = None) -> bytes:
         try:
@@ -129,6 +140,8 @@ class FsClient:
         if size is None:
             size = inode.size - offset
         size = max(0, min(size, inode.size - offset))
+        if not self.cold:
+            return self.hot.read(inode.ino, offset, size)
         out = bytearray()
         pos = 0
         for ext in inode.obj_extents:

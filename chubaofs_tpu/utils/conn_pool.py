@@ -1,0 +1,54 @@
+"""Pooled TCP connections keyed by address (util/conn_pool.go analog).
+
+The reference pools idle conns per target with an idle timeout and closes on
+error (util/conn_pool.go); same policy here. A checked-out socket is returned
+via put(ok=...) — broken sockets are dropped, healthy ones reused."""
+
+from __future__ import annotations
+
+import socket
+import threading
+import time
+
+
+class ConnPool:
+    def __init__(self, idle_timeout: float = 30.0, connect_timeout: float = 5.0,
+                 io_timeout: float = 30.0):
+        self.idle_timeout = idle_timeout
+        self.connect_timeout = connect_timeout
+        self.io_timeout = io_timeout
+        self._idle: dict[str, list[tuple[socket.socket, float]]] = {}
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def _split(addr: str) -> tuple[str, int]:
+        host, port = addr.rsplit(":", 1)
+        return host, int(port)
+
+    def get(self, addr: str) -> socket.socket:
+        with self._lock:
+            bucket = self._idle.get(addr, [])
+            while bucket:
+                sock, ts = bucket.pop()
+                if time.time() - ts <= self.idle_timeout:
+                    return sock
+                sock.close()
+        host, port = self._split(addr)
+        sock = socket.create_connection((host, port), timeout=self.connect_timeout)
+        sock.settimeout(self.io_timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def put(self, addr: str, sock: socket.socket, ok: bool = True) -> None:
+        if not ok:
+            sock.close()
+            return
+        with self._lock:
+            self._idle.setdefault(addr, []).append((sock, time.time()))
+
+    def close(self) -> None:
+        with self._lock:
+            for bucket in self._idle.values():
+                for sock, _ in bucket:
+                    sock.close()
+            self._idle.clear()
