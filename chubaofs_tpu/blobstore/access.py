@@ -134,6 +134,15 @@ class Access:
     # -- PUT -----------------------------------------------------------------
 
     def put(self, data: bytes, code_mode: CodeMode | int | None = None) -> Location:
+        from chubaofs_tpu.blobstore import trace
+
+        with trace.child_of(trace.current_span(), "access.put") as span:
+            span.set_tag("size", len(data))
+            loc = self._put(data, code_mode)
+            span.append_track_log("access")
+            return loc
+
+    def _put(self, data: bytes, code_mode: CodeMode | int | None = None) -> Location:
         if not data:
             raise AccessError("empty put")
         mode = int(code_mode) if code_mode is not None else int(select_code_mode(len(data)))
@@ -220,6 +229,14 @@ class Access:
     # -- GET -----------------------------------------------------------------
 
     def get(self, loc: Location | str, offset: int = 0, size: int | None = None) -> bytes:
+        from chubaofs_tpu.blobstore import trace
+
+        with trace.child_of(trace.current_span(), "access.get") as span:
+            data = self._get(loc, offset, size)
+            span.append_track_log("access")
+            return data
+
+    def _get(self, loc: Location | str, offset: int = 0, size: int | None = None) -> bytes:
         if isinstance(loc, str):
             loc = Location.from_json(loc)
         self._check_sig(loc)

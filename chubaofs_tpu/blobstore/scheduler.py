@@ -71,11 +71,19 @@ class Scheduler:
     """Leader-elected background brain (single leader here; raft wraps later)."""
 
     def __init__(self, cm: ClusterMgr, proxy: Proxy, nodes: dict[int, BlobNode],
-                 codec: CodecService | None = None):
+                 codec: CodecService | None = None, record_log=None):
+        from chubaofs_tpu.blobstore.taskswitch import SwitchMgr
+
         self.cm = cm
         self.proxy = proxy
         self.nodes = nodes
         self.codec = codec or default_service()
+        # switches persist in the clustermgr config KV (task_switch.go:26);
+        # pull persisted state so a restarted scheduler honors prior settings
+        self.switches = SwitchMgr(config_get=cm.get_config,
+                                  config_set=cm.set_config)
+        self.switches.refresh()
+        self.record_log = record_log  # common/recordlog: finished-task audit
         self._lock = threading.Lock()
         self._tasks: dict[str, Task] = {}
         self._seq = 0
@@ -105,6 +113,10 @@ class Scheduler:
 
         Deduped by (vid, bid): every degraded GET emits a message, but one open
         task repairs the whole stripe."""
+        from chubaofs_tpu.blobstore.taskswitch import SWITCH_SHARD_REPAIR
+
+        if not self.switches.enabled(SWITCH_SHARD_REPAIR):
+            return 0
         topic = self.proxy.topics[TOPIC_SHARD_REPAIR]
         msgs = topic.consume("scheduler", max_msgs)
         with self._lock:
@@ -129,6 +141,10 @@ class Scheduler:
 
         Destination disks are picked per-volume at execution time so the
         no-two-units-of-a-volume-per-disk invariant holds."""
+        from chubaofs_tpu.blobstore.taskswitch import SWITCH_DISK_REPAIR
+
+        if not self.switches.enabled(SWITCH_DISK_REPAIR):
+            return []
         out = []
         for disk in self.cm.broken_disks():
             # an open (prepared/working) task blocks re-creation; a FAILED one
@@ -179,12 +195,29 @@ class Scheduler:
                 t.retries += 1
                 t.error = error
                 t.state = TASK_PREPARED if t.retries < 3 else TASK_FAILED
+            record = None
+            if self.record_log is not None and t.state in (TASK_FINISHED, TASK_FAILED):
+                record = {
+                    "task_id": t.task_id, "kind": t.kind, "state": t.state,
+                    "vid": t.vid, "bid": t.bid, "disk_id": t.disk_id,
+                    "retries": t.retries, "error": t.error,
+                }
+        # record outside the lock; the audit trail must never alter task state
+        if record is not None:
+            try:
+                self.record_log.encode(record)
+            except OSError:
+                pass
 
     # -- blob deleter ---------------------------------------------------------
 
     def run_deleter(self, max_msgs: int = 64) -> int:
         """Consume delete messages -> mark-delete then punch-hole on blobnodes
         (blob_deleter.go two-phase analog)."""
+        from chubaofs_tpu.blobstore.taskswitch import SWITCH_BLOB_DELETE
+
+        if not self.switches.enabled(SWITCH_BLOB_DELETE):
+            return 0
         topic = self.proxy.topics[TOPIC_BLOB_DELETE]
         msgs = topic.consume("deleter", max_msgs)
         for m in msgs:

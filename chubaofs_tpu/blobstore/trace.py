@@ -1,0 +1,107 @@
+"""Distributed tracing — spans with in-band propagation + RPC track logs.
+
+Reference counterpart: blobstore/common/trace (tracer.go:34 opentracing
+aliases, span.go:25-35) — every blobstore ctx carries a span; services append
+"track log" entries (module:latency/result) that ride response headers so the
+access gateway can log one line covering the whole fan-out (used at
+access/stream_put.go:47,100). Kept: trace-id propagation, child spans, track
+logs appended bottom-up. The carrier is a plain dict standing in for HTTP
+headers (inject/extract), so both in-process and HTTP hops propagate the same
+way.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+
+TRACE_ID_KEY = "Trace-Id"
+TRACK_LOG_KEY = "Trace-Tracklog"
+
+_local = threading.local()
+
+
+class Span:
+    def __init__(self, operation: str, trace_id: str | None = None,
+                 parent: "Span | None" = None):
+        self.operation = operation
+        self.trace_id = trace_id or (parent.trace_id if parent else uuid.uuid4().hex[:16])
+        self.parent = parent
+        self.start = time.perf_counter()
+        self.tags: dict[str, object] = {}
+        self.logs: list[tuple[float, str]] = []
+        self.track: list[str] = []  # track-log entries, e.g. "blobnode:12ms"
+        self.finished_us: int | None = None
+
+    # -- opentracing-style surface ---------------------------------------------
+    def set_tag(self, k: str, v) -> "Span":
+        self.tags[k] = v
+        return self
+
+    def log(self, msg: str):
+        self.logs.append((time.perf_counter() - self.start, msg))
+
+    def append_track_log(self, module: str, start: float | None = None,
+                         err: Exception | None = None):
+        """stream_put.go:100-style: module + elapsed + error class."""
+        ms = int(((time.perf_counter() - (start or self.start)) * 1000))
+        entry = f"{module}:{ms}"
+        if err is not None:
+            entry += f"/{type(err).__name__}"
+        self.track.append(entry)
+
+    def finish(self):
+        if self.finished_us is None:
+            self.finished_us = int((time.perf_counter() - self.start) * 1e6)
+            if self.parent is not None:
+                self.parent.track.extend(self.track)
+
+    def __enter__(self):
+        push_span(self)
+        return self
+
+    def __exit__(self, et, ev, tb):
+        self.finish()
+        pop_span()
+        return False
+
+    # -- propagation -----------------------------------------------------------
+    def inject(self, carrier: dict):
+        carrier[TRACE_ID_KEY] = self.trace_id
+        if self.track:
+            carrier[TRACK_LOG_KEY] = ";".join(self.track)
+
+    def track_log_string(self) -> str:
+        return ";".join(self.track)
+
+
+def start_span(operation: str, carrier: dict | None = None) -> Span:
+    """New root (or remote-continued, when carrier holds a trace id) span."""
+    tid = carrier.get(TRACE_ID_KEY) if carrier else None
+    span = Span(operation, trace_id=tid)
+    if carrier and TRACK_LOG_KEY in carrier:
+        span.track.extend(carrier[TRACK_LOG_KEY].split(";"))
+    return span
+
+
+def child_of(parent: Span | None, operation: str) -> Span:
+    return Span(operation, parent=parent) if parent else Span(operation)
+
+
+def push_span(span: Span):
+    stack = getattr(_local, "stack", None)
+    if stack is None:
+        stack = _local.stack = []
+    stack.append(span)
+
+
+def pop_span():
+    stack = getattr(_local, "stack", None)
+    if stack:
+        stack.pop()
+
+
+def current_span() -> Span | None:
+    stack = getattr(_local, "stack", None)
+    return stack[-1] if stack else None

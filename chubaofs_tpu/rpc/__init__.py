@@ -1,0 +1,15 @@
+"""HTTP RPC framework (blobstore/common/rpc analog).
+
+Router + typed errors + server + middleware (auditlog, shared-secret auth,
+crc-protected bodies) + a small retrying client. Serves every HTTP surface in
+the framework: blobstore gateways, objectnode S3, authnode, console, master
+admin API.
+"""
+
+from chubaofs_tpu.rpc.errors import HTTPError, err_response
+from chubaofs_tpu.rpc.router import Request, Response, Router
+from chubaofs_tpu.rpc.server import RPCServer
+from chubaofs_tpu.rpc.client import RPCClient
+
+__all__ = ["HTTPError", "err_response", "Request", "Response", "Router",
+           "RPCServer", "RPCClient"]

@@ -1,0 +1,79 @@
+"""Retrying HTTP client (blobstore/common/rpc client + api/* typed clients).
+
+Reference counterpart: common/rpc's LbClient — round-robin over hosts with
+retry-on-5xx/conn-error, JSON bodies, crc-body headers, and error
+re-hydration into typed codes (api/access/client.go:248 builds on it). Kept:
+host rotation, bounded retries with backoff, HTTPError re-hydration, optional
+auth signing and body crc.
+"""
+
+from __future__ import annotations
+
+import http.client
+import time
+import zlib
+
+from chubaofs_tpu.rpc.errors import HTTPError
+from chubaofs_tpu.rpc.server import AUTH_HEADER, CRC_HEADER, sign_path
+
+
+class RPCClient:
+    def __init__(self, hosts: list[str], retries: int = 3, timeout: float = 30.0,
+                 auth_secret: bytes | None = None, backoff: float = 0.05):
+        self.hosts = list(hosts)
+        self.retries = retries
+        self.timeout = timeout
+        self.auth_secret = auth_secret
+        self.backoff = backoff
+        self._rr = 0
+
+    def _next_host(self) -> str:
+        h = self.hosts[self._rr % len(self.hosts)]
+        self._rr += 1
+        return h
+
+    def do(self, method: str, path: str, body: bytes = b"",
+           headers: dict | None = None, crc: bool = False) -> tuple[int, dict, bytes]:
+        hdrs = dict(headers or {})
+        if self.auth_secret is not None:
+            # sign the DECODED path: the server router hands middleware the
+            # percent-decoded form, so both ends must hash the same bytes
+            import urllib.parse
+
+            plain = urllib.parse.unquote(path.split("?", 1)[0])
+            hdrs[AUTH_HEADER] = sign_path(self.auth_secret, plain)
+        if crc and body:
+            hdrs[CRC_HEADER] = str(zlib.crc32(body) & 0xFFFFFFFF)
+        last: Exception | None = None
+        for attempt in range(self.retries):
+            host = self._next_host()
+            try:
+                conn = http.client.HTTPConnection(host, timeout=self.timeout)
+                try:
+                    conn.request(method, path, body=body or None, headers=hdrs)
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    if resp.status < 500:
+                        return resp.status, dict(resp.getheaders()), data
+                    last = HTTPError.from_body(resp.status, data)
+                finally:
+                    conn.close()
+            except (ConnectionError, OSError, http.client.HTTPException) as e:
+                last = e
+            time.sleep(self.backoff * (attempt + 1))
+        raise last if last else HTTPError(503, msg="no hosts")
+
+    def request_json(self, method: str, path: str, obj=None, **kw):
+        import json
+
+        body = json.dumps(obj).encode() if obj is not None else b""
+        status, headers, data = self.do(method, path, body, **kw)
+        if status >= 400:
+            raise HTTPError.from_body(status, data)
+        return json.loads(data.decode() or "null")
+
+    def get(self, path: str, **kw):
+        return self.request_json("GET", path, **kw)
+
+    def post(self, path: str, obj=None, **kw):
+        return self.request_json("POST", path, obj, **kw)

@@ -1,0 +1,121 @@
+"""Threaded HTTP server bound to a Router, plus standard middleware.
+
+Reference counterpart: common/rpc's server glue + middleware stack — auditlog
+middleware (common/rpc/auditlog), shared-secret auth middleware
+(common/rpc/auth: an HMAC of the request path with a cluster secret rides a
+header), and crc-protected request bodies (clients send a crc32 header; the
+server verifies before dispatch). The profile mux (common/profile: /metrics +
+/debug endpoints always mounted) appears here as the default routes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import threading
+import time
+import zlib
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from chubaofs_tpu.rpc.router import Request, Response, Router, parse_request
+
+AUTH_HEADER = "blob-auth"
+CRC_HEADER = "x-crc-body"
+
+
+def auth_middleware(secret: bytes):
+    """common/rpc/auth analog: HMAC-SHA1(path) must ride AUTH_HEADER."""
+
+    def mw(req: Request, nxt):
+        want = hmac.new(secret, req.path.encode(), hashlib.sha1).hexdigest()
+        if not hmac.compare_digest(req.header(AUTH_HEADER), want):
+            return Response(403, {}, b'{"error":"auth mismatch"}')
+        return nxt(req)
+
+    return mw
+
+
+def sign_path(secret: bytes, path: str) -> str:
+    return hmac.new(secret, path.encode(), hashlib.sha1).hexdigest()
+
+
+def crc_middleware(req: Request, nxt):
+    """Verify crc32 of the body when the client attached CRC_HEADER."""
+    want = req.header(CRC_HEADER)
+    if want:
+        try:
+            expected = int(want)
+        except ValueError:
+            return Response(400, {}, b'{"error":"bad crc header","code":"CrcMismatch"}')
+        if expected != (zlib.crc32(req.body) & 0xFFFFFFFF):
+            return Response(400, {}, b'{"error":"body crc mismatch","code":"CrcMismatch"}')
+    return nxt(req)
+
+
+def audit_middleware(audit):
+    """common/rpc/auditlog analog over utils.auditlog.AuditLog."""
+
+    def mw(req: Request, nxt):
+        t0 = time.perf_counter()
+        resp = nxt(req)
+        audit.log_http(req.method, req.path, resp.status,
+                       int((time.perf_counter() - t0) * 1e6), req.remote,
+                       len(req.body), len(resp.body))
+        return resp
+
+    return mw
+
+
+class RPCServer:
+    """ThreadingHTTPServer hosting one Router; /metrics mounted by default."""
+
+    def __init__(self, router: Router, host: str = "127.0.0.1", port: int = 0,
+                 registry=None):
+        self.router = router
+        if registry is not None:
+            router.get("/metrics", lambda r: Response(
+                200, {"Content-Type": "text/plain"}, registry.render().encode()))
+
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # silence default stderr chatter
+                pass
+
+            def _serve(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length) if length else b""
+                req = parse_request(self.command, self.path,
+                                    dict(self.headers.items()), body,
+                                    remote=self.client_address[0])
+                resp = outer.router.dispatch(req)
+                self.send_response(resp.status)
+                payload = b"" if self.command == "HEAD" else resp.body
+                for k, v in resp.headers.items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(resp.body)))
+                self.end_headers()
+                if payload:
+                    self.wfile.write(payload)
+
+            do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _serve
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.httpd.daemon_threads = True
+        self.addr = f"{host}:{self.httpd.server_address[1]}"
+        self.port = self.httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever,
+                                        name=f"rpc@{self.addr}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5)

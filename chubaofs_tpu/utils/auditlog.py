@@ -1,0 +1,95 @@
+"""Audit log — append-only, size-rotated op log shared by client and gateways.
+
+Reference counterpart: util/auditlog/auditlog.go:74-161 (client fs-op audit —
+timestamp, client addr, volume, op, path, error, latency, ino — written to a
+rotating file set with a shrink-on-total-size policy) and the blobstore HTTP
+auditlog middleware (common/rpc/auditlog). One implementation serves both: a
+`AuditLog` with `log_fs_op` / `log_http` formatters over the same rotor.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+
+class RotatingFile:
+    """Size-rotated append file ring: name.log, name.log.1 .. name.log.N.
+
+    The one rotor shared by the fs audit log, the blobstore recordlog, and any
+    other append-only trail (auditlog.go's total-size shrink policy, expressed
+    as a bounded file ring). Thread-safe; lines are written whole."""
+
+    def __init__(self, logdir: str, prefix: str, max_bytes: int, max_files: int):
+        self.dir = logdir
+        self.prefix = prefix
+        self.max_bytes = max_bytes
+        self.max_files = max_files
+        self._lock = threading.Lock()
+        os.makedirs(logdir, exist_ok=True)
+        self._fh = None
+        self._open()
+
+    def path(self, n: int = 0) -> str:
+        return os.path.join(self.dir, f"{self.prefix}.log" + (f".{n}" if n else ""))
+
+    def _open(self):
+        self._fh = open(self.path(), "a", encoding="utf-8")
+        self._size = self._fh.tell()
+
+    def _rotate_locked(self):
+        self._fh.close()
+        for n in range(self.max_files - 1, 0, -1):
+            src = self.path(n - 1) if n > 1 else self.path()
+            if os.path.exists(src):
+                os.replace(src, self.path(n))
+        self._open()
+
+    def write_line(self, line: str):
+        with self._lock:
+            if self._fh is None:
+                return
+            self._fh.write(line + "\n")
+            self._fh.flush()
+            self._size += len(line) + 1
+            if self._size >= self.max_bytes:
+                self._rotate_locked()
+
+    def read_lines(self) -> list[str]:
+        """Every retained line, oldest first, across rotations."""
+        out: list[str] = []
+        for n in range(self.max_files, -1, -1):
+            p = self.path(n)
+            if os.path.exists(p):
+                with open(p, encoding="utf-8") as f:
+                    out.extend(line.rstrip("\n") for line in f if line.strip())
+        return out
+
+    def close(self):
+        with self._lock:
+            if self._fh:
+                self._fh.close()
+                self._fh = None
+
+
+class AuditLog:
+    def __init__(self, logdir: str, prefix: str = "audit",
+                 max_bytes: int = 4 << 20, max_files: int = 8):
+        self._rotor = RotatingFile(logdir, prefix, max_bytes, max_files)
+
+    def log_fs_op(self, client: str, volume: str, op: str, path: str,
+                  err: str = "", latency_us: int = 0, ino: int = 0):
+        ts = time.strftime("%Y-%m-%d %H:%M:%S")
+        self._rotor.write_line(",".join([ts, client, volume, op, path,
+                                         err or "nil", str(latency_us), str(ino)]))
+
+    def log_http(self, method: str, path: str, status: int, latency_us: int,
+                 remote: str = "-", req_bytes: int = 0, resp_bytes: int = 0):
+        ts = time.strftime("%Y-%m-%d %H:%M:%S")
+        self._rotor.write_line(",".join([ts, remote, method, path, str(status),
+                                         str(req_bytes), str(resp_bytes),
+                                         str(latency_us)]))
+
+    def close(self):
+        self._rotor.close()

@@ -1,0 +1,156 @@
+"""Metrics exporter — Prometheus-style registry + text exposition + TP timers.
+
+Reference counterpart: util/exporter/exporter.go:31-42,100 (Prometheus registry
+with namespace `cfs_{cluster}_{module}`, Counter/Gauge/TP metric kinds,
+optional Consul self-registration via util/exporter/consul_register.go) and the
+UMP-style TP counters wrapped by exporter.NewTPCnt (metanode/manager.go:109).
+Design kept: a process-global registry, metrics keyed by (name, sorted labels),
+`NewTPCnt`-style timers that record both a count and latency; the render format
+is the Prometheus text format so any scraper can consume it. Consul
+registration is represented by a registration record (host/port/path) the
+deployment can act on — no live agent in this environment.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+
+def _key(name: str, labels: dict[str, str] | None) -> tuple:
+    return (name, tuple(sorted((labels or {}).items())))
+
+
+class Counter:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def add(self, v: float = 1.0):
+        with self._lock:
+            self.value += v
+
+
+class Gauge:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0.0
+        self._lock = threading.Lock()
+
+    def set(self, v: float):
+        with self._lock:
+            self.value = float(v)
+
+
+class Summary:
+    """Latency summary: count, sum, max — the shape UMP TP logs report
+    (util/ump/ump.go:76-92 logs elapsed micros per key; aggregation happens
+    downstream, so count/sum/max is the lossless per-process reduction)."""
+
+    __slots__ = ("count", "sum", "max", "_lock")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.max = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float):
+        with self._lock:
+            self.count += 1
+            self.sum += seconds
+            if seconds > self.max:
+                self.max = seconds
+
+
+class TPObject:
+    """exporter.NewTPCnt analog: time an op, count it, flag errors."""
+
+    def __init__(self, registry: "Registry", name: str, labels: dict | None):
+        self.registry = registry
+        self.name = name
+        self.labels = labels
+        self.start = time.perf_counter()
+
+    def set(self, err: Exception | None = None):
+        elapsed = time.perf_counter() - self.start
+        self.registry.summary(self.name, self.labels).observe(elapsed)
+        if err is not None:
+            self.registry.counter(self.name + "_errors", self.labels).add()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, et, ev, tb):
+        self.set(ev if isinstance(ev, Exception) else None)
+        return False
+
+
+class Registry:
+    def __init__(self, cluster: str = "cfs", module: str = ""):
+        self.namespace = "_".join(x for x in ("cfs", cluster, module) if x)
+        self._metrics: dict[tuple, object] = {}
+        self._kinds: dict[str, str] = {}
+        self._lock = threading.Lock()
+        self.consul_registration: dict | None = None
+
+    def _get(self, kind: str, name: str, labels, factory):
+        k = _key(name, labels)
+        with self._lock:
+            m = self._metrics.get(k)
+            if m is None:
+                m = self._metrics[k] = factory()
+                self._kinds[name] = kind
+            return m
+
+    def counter(self, name: str, labels: dict | None = None) -> Counter:
+        return self._get("counter", name, labels, Counter)
+
+    def gauge(self, name: str, labels: dict | None = None) -> Gauge:
+        return self._get("gauge", name, labels, Gauge)
+
+    def summary(self, name: str, labels: dict | None = None) -> Summary:
+        return self._get("summary", name, labels, Summary)
+
+    def tp(self, name: str, labels: dict | None = None) -> TPObject:
+        """Start a TP timer; call .set(err) or use as a context manager."""
+        return TPObject(self, name, labels)
+
+    def register_consul(self, addr: str, port: int, path: str = "/metrics"):
+        """util/exporter/consul_register.go analog — record the registration."""
+        self.consul_registration = {"addr": addr, "port": port, "path": path}
+
+    def render(self) -> str:
+        """Prometheus text exposition of every metric in the registry."""
+        lines = []
+        with self._lock:
+            items = sorted(self._metrics.items())
+        for (name, labels), m in items:
+            full = f"{self.namespace}_{name}"
+            lab = ("{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}") if labels else ""
+            if isinstance(m, Counter):
+                lines.append(f"{full}{lab} {m.value}")
+            elif isinstance(m, Gauge):
+                lines.append(f"{full}{lab} {m.value}")
+            elif isinstance(m, Summary):
+                lines.append(f"{full}_count{lab} {m.count}")
+                lines.append(f"{full}_sum{lab} {m.sum}")
+                lines.append(f"{full}_max{lab} {m.max}")
+        return "\n".join(lines) + "\n"
+
+
+_default = Registry()
+
+
+def default_registry() -> Registry:
+    return _default
+
+
+def init(cluster: str, module: str) -> Registry:
+    """Re-namespace the process-global registry (exporter.Init analog)."""
+    global _default
+    _default = Registry(cluster, module)
+    return _default

@@ -1,0 +1,222 @@
+"""Tests for observability utils + rpc framework + blobstore common infra."""
+
+import threading
+
+import pytest
+
+from chubaofs_tpu.blobstore.iostat import IOStat
+from chubaofs_tpu.blobstore.recordlog import RecordLog
+from chubaofs_tpu.blobstore.resourcepool import MemPool, PoolLimitError
+from chubaofs_tpu.blobstore.taskswitch import SWITCH_BALANCE, SwitchMgr
+from chubaofs_tpu.blobstore import trace
+from chubaofs_tpu.rpc import HTTPError, RPCClient, RPCServer, Response, Router
+from chubaofs_tpu.rpc.server import audit_middleware, auth_middleware, crc_middleware
+from chubaofs_tpu.utils.auditlog import AuditLog
+from chubaofs_tpu.utils.config import Config, ConfigError
+from chubaofs_tpu.utils.exporter import Registry
+
+
+# -- exporter -------------------------------------------------------------------
+
+def test_exporter_counts_and_renders():
+    reg = Registry("c1", "master")
+    reg.counter("ops", {"op": "put"}).add()
+    reg.counter("ops", {"op": "put"}).add(2)
+    reg.gauge("disks").set(7)
+    with reg.tp("put_latency"):
+        pass
+    text = reg.render()
+    assert "cfs_c1_master_ops" in text and 'op="put"' in text and "3.0" in text
+    assert "cfs_c1_master_disks 7.0" in text
+    assert "cfs_c1_master_put_latency_count 1" in text
+
+
+def test_exporter_tp_records_errors():
+    reg = Registry()
+    with pytest.raises(ValueError):
+        with reg.tp("op"):
+            raise ValueError("x")
+    assert reg.counter("op_errors").value == 1
+
+
+# -- config ---------------------------------------------------------------------
+
+def test_config_typed_getters_and_nesting():
+    cfg = Config.from_string(
+        '{"role": "master", "port": 17010, "ratio": 0.5, "on": "true",'
+        ' "peers": [1, 2], "mod": {"sub": {"x": 9}}}')
+    assert cfg.get_string("role") == "master"
+    assert cfg.get_int("port") == 17010
+    assert cfg.get_float("ratio") == 0.5
+    assert cfg.get_bool("on") is True
+    assert cfg.get_slice("peers") == [1, 2]
+    assert cfg.get_int("mod.sub.x") == 9
+    assert cfg.sub("mod").get_int("sub.x") == 9
+    with pytest.raises(ConfigError):
+        cfg.check_required("role", "missing_key")
+
+
+# -- auditlog -------------------------------------------------------------------
+
+def test_auditlog_writes_and_rotates(tmp_path):
+    log = AuditLog(str(tmp_path), max_bytes=256, max_files=3)
+    for i in range(40):
+        log.log_fs_op("c1", "vol", "Create", f"/a/{i}", latency_us=5)
+    log.close()
+    files = sorted(p.name for p in tmp_path.iterdir())
+    assert "audit.log" in files and len(files) > 1
+
+
+# -- trace ----------------------------------------------------------------------
+
+def test_trace_span_propagation_and_tracklog():
+    root = trace.start_span("access.put")
+    with root:
+        child = trace.child_of(trace.current_span(), "blobnode.putshard")
+        with child:
+            child.append_track_log("blobnode")
+        root.append_track_log("access")
+    assert child.trace_id == root.trace_id
+    carrier = {}
+    root.inject(carrier)
+    assert carrier["Trace-Id"] == root.trace_id
+    # child track entries bubble up into the parent (stream_put.go:100 shape)
+    assert any(e.startswith("blobnode:") for e in root.track)
+    cont = trace.start_span("remote", carrier)
+    assert cont.trace_id == root.trace_id
+
+
+# -- taskswitch -----------------------------------------------------------------
+
+def test_taskswitch_blocks_and_resumes():
+    kv = {}
+    mgr = SwitchMgr(config_get=kv.get,
+                    config_set=lambda k, v: kv.__setitem__(k, v))
+    mgr.set(SWITCH_BALANCE, False)
+    assert not mgr.enabled(SWITCH_BALANCE)
+    assert kv["task_switch/balance"] == "false"
+    waited = []
+
+    def waiter():
+        waited.append(mgr.switch(SWITCH_BALANCE).wait_enable(timeout=5))
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    mgr.set(SWITCH_BALANCE, True)
+    t.join(timeout=5)
+    assert waited == [True]
+    # refresh() pulls persisted state back
+    kv["task_switch/balance"] = "false"
+    mgr.refresh()
+    assert not mgr.enabled(SWITCH_BALANCE)
+
+
+# -- iostat ---------------------------------------------------------------------
+
+def test_iostat_shared_counters(tmp_path):
+    st = IOStat("t", path=str(tmp_path / "io"))
+    st.write_begin()
+    st.write_done(4096, 120)
+    st.read_begin()
+    st.read_done(1024, 80)
+    view = IOStat.view(str(tmp_path / "io"))
+    assert view["wcnt"] == 1 and view["wbytes"] == 4096
+    assert view["rcnt"] == 1 and view["rbytes"] == 1024 and view["rpending"] == 0
+    st.close()
+
+
+# -- recordlog ------------------------------------------------------------------
+
+def test_recordlog_roundtrip(tmp_path):
+    rl = RecordLog(str(tmp_path), max_bytes=200, backups=3)
+    for i in range(20):
+        rl.encode({"task": i, "kind": "repair"})
+    recs = rl.records()
+    assert {"task": 19, "kind": "repair"} in recs and len(recs) > 5
+    rl.close()
+
+
+# -- resourcepool ---------------------------------------------------------------
+
+def test_mempool_classes_and_limit():
+    pool = MemPool(classes=(1024, 4096), capacity_bytes=8192)
+    b = pool.alloc(1000)
+    assert len(b) == 1024
+    b[0] = 0xFF
+    pool.put(b)
+    b2 = pool.alloc(1024)
+    assert b2[0] == 0  # zeroed on reuse
+    pool.alloc(4096)
+    pool.alloc(1024)  # 1024(b2) + 4096 + 1024 = 6144
+    with pytest.raises(PoolLimitError):
+        pool.alloc(4096)
+
+
+# -- rpc ------------------------------------------------------------------------
+
+@pytest.fixture()
+def rpc_server(tmp_path):
+    router = Router()
+    reg = Registry("t", "svc")
+    reg.gauge("up").set(1)
+    audit = AuditLog(str(tmp_path))
+    router.middleware.append(audit_middleware(audit))
+    router.middleware.append(crc_middleware)
+    router.get("/get/:vid", lambda r: {"vid": int(r.params["vid"])})
+    router.post("/echo", lambda r: Response(200, {}, r.body))
+    router.get("/boom", lambda r: (_ for _ in ()).throw(
+        HTTPError(404, "NotFound", "vanished")))
+    srv = RPCServer(router, registry=reg).start()
+    yield srv
+    srv.stop()
+    audit.close()
+
+
+def test_rpc_route_params_and_errors(rpc_server):
+    cli = RPCClient([rpc_server.addr])
+    assert cli.get("/get/42") == {"vid": 42}
+    with pytest.raises(HTTPError) as ei:
+        cli.get("/boom")
+    assert ei.value.status == 404 and ei.value.code == "NotFound"
+    status, _, _ = cli.do("GET", "/nope")
+    assert status == 404
+
+
+def test_rpc_crc_body_and_metrics(rpc_server):
+    cli = RPCClient([rpc_server.addr])
+    status, _, data = cli.do("POST", "/echo", b"payload", crc=True)
+    assert status == 200 and data == b"payload"
+    # corrupt crc rejected
+    status, _, _ = cli.do("POST", "/echo", b"payload",
+                          headers={"x-crc-body": "1"})
+    assert status == 400
+    status, _, text = cli.do("GET", "/metrics")
+    assert status == 200 and b"cfs_t_svc" in text
+
+
+def test_rpc_auth_middleware():
+    router = Router()
+    router.middleware.append(auth_middleware(b"s3cret"))
+    router.get("/ok", lambda r: {"ok": True})
+    srv = RPCServer(router).start()
+    try:
+        good = RPCClient([srv.addr], auth_secret=b"s3cret")
+        assert good.get("/ok") == {"ok": True}
+        bad = RPCClient([srv.addr], auth_secret=b"wrong")
+        with pytest.raises(HTTPError) as ei:
+            bad.get("/ok")
+        assert ei.value.status == 403
+    finally:
+        srv.stop()
+
+
+def test_router_query_conditions():
+    router = Router()
+    router.get("/b/:name", lambda r: {"which": "uploads"}, queries={"uploads": None})
+    router.get("/b/:name", lambda r: {"which": "plain"})
+    from chubaofs_tpu.rpc.router import parse_request
+
+    req = parse_request("GET", "/b/x?uploads=", {}, b"")
+    assert router.dispatch(req).body == b'{"which": "uploads"}'
+    req2 = parse_request("GET", "/b/x", {}, b"")
+    assert router.dispatch(req2).body == b'{"which": "plain"}'
