@@ -204,6 +204,12 @@ class FsCluster:
     def create_volume(self, name: str, cold: bool = True) -> None:
         self.master().create_volume(name, cold=cold)
 
+    def volume_names(self) -> list[str]:
+        return sorted(self.master().sm.volumes)
+
+    def delete_volume(self, name: str) -> None:
+        self.master().delete_volume(name)
+
     def client(self, volume: str) -> FsClient:
         meta = MetaWrapper(self.master(), self.metanodes, volume)
         vol = self.master().get_volume(volume)

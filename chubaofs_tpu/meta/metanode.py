@@ -96,6 +96,18 @@ class MetaNode:
         except MetaError as e:
             raise OpError(e.code, str(e)) from None
 
+    def multipart_get(self, partition_id: int, upload_id: str):
+        try:
+            return self._leader_sm(partition_id).multipart_get(upload_id)
+        except MetaError as e:
+            raise OpError(e.code, str(e)) from None
+
+    def multipart_list(self, partition_id: int):
+        try:
+            return self._leader_sm(partition_id).multipart_list()
+        except MetaError as e:
+            raise OpError(e.code, str(e)) from None
+
     # -- freelist delete loop (partition_free_list.go:180,233 analog) ----------
 
     def drain_freelists(self) -> int:

@@ -348,13 +348,24 @@ class MetaPartitionSM(StateMachine):
         return upload_id
 
     def _op_multipart_put_part(self, upload_id: str, part_num: int, location: dict):
+        """Returns the replaced part's location (None for a fresh part) so the
+        caller can reclaim the superseded data (S3 UploadPart retry semantics)."""
         mp = self.multipart.get(upload_id)
         if mp is None:
             raise NoEntry(f"upload {upload_id}")
+        old = mp["parts"].get(part_num)
         mp["parts"][part_num] = location
-        return part_num
+        return old
 
     def _op_multipart_complete(self, upload_id: str):
+        mp = self.multipart.pop(upload_id, None)
+        if mp is None:
+            raise NoEntry(f"upload {upload_id}")
+        return mp
+
+    def _op_multipart_abort(self, upload_id: str):
+        """Same pop as complete; the caller deletes the part data instead of
+        linking it (objectnode AbortMultipartUpload path)."""
         mp = self.multipart.pop(upload_id, None)
         if mp is None:
             raise NoEntry(f"upload {upload_id}")
@@ -383,3 +394,12 @@ class MetaPartitionSM(StateMachine):
 
     def owns_ino(self, ino: int) -> bool:
         return self.start <= ino < self.end
+
+    def multipart_get(self, upload_id: str) -> dict:
+        mp = self.multipart.get(upload_id)
+        if mp is None:
+            raise NoEntry(f"upload {upload_id}")
+        return mp
+
+    def multipart_list(self) -> dict[str, dict]:
+        return dict(self.multipart)

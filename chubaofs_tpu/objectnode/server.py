@@ -1,0 +1,637 @@
+"""ObjectNode — the S3-compatible gateway (objectnode/ analog).
+
+Reference counterpart: objectnode/router.go:26 (gorilla/mux routing of the S3
+action set), api_handler_object.go:1172 (putObjectHandler),
+fs_volume.go:596 (Volume.PutObject), auth_signature_v2.go/v4.go, the
+policy/acl/cors/tagging engines, objectnode/server.go. Buckets map 1:1 onto
+volumes; object data rides the same meta+data planes as the POSIX client —
+EC-on-TPU for cold volumes — so S3 and FUSE views of a volume agree
+(CHANGELOG.md:12's blobstore docking).
+
+Supported S3 actions: ListBuckets, Create/Delete/Head Bucket,
+GetBucketLocation, ListObjects V1/V2, Put/Get/Head/Delete/Copy Object,
+DeleteObjects (batch), Range GET, Bucket+Object ACL, Bucket Policy,
+Bucket CORS (+ preflight), Bucket+Object Tagging, full multipart
+(Initiate/UploadPart/List/Complete/Abort/ListUploads).
+"""
+
+from __future__ import annotations
+
+import re
+import urllib.parse
+import xml.etree.ElementTree as ET
+from xml.sax.saxutils import escape as esc
+
+from chubaofs_tpu.objectnode import auth as s3auth
+from chubaofs_tpu.objectnode.acl import ACL, XATTR_ACL
+from chubaofs_tpu.objectnode.cors import CORSConfig, XATTR_CORS
+from chubaofs_tpu.objectnode.multipart import (
+    InvalidPart, MultipartManager, NoSuchUpload,
+)
+from chubaofs_tpu.objectnode.policy import (
+    ACTION_DELETE, ACTION_GET, ACTION_LIST, ACTION_PUT, ALLOW, DENY, Policy,
+    PolicyError, XATTR_POLICY,
+)
+from chubaofs_tpu.objectnode.volume import NoSuchKey, OSSVolume
+from chubaofs_tpu.rpc import Response, Router
+from chubaofs_tpu.rpc.router import Request
+from chubaofs_tpu.sdk.fs import FsError
+
+
+class S3Error(Exception):
+    def __init__(self, status: int, code: str, msg: str = ""):
+        super().__init__(code)
+        self.status = status
+        self.code = code
+        self.msg = msg or code
+
+
+def _xml_error(e: S3Error, resource: str = "") -> Response:
+    body = (f"<Error><Code>{esc(e.code)}</Code><Message>{esc(e.msg)}</Message>"
+            f"<Resource>{esc(resource)}</Resource></Error>")
+    return Response.xml(body, e.status)
+
+
+def _parse_xml(body: bytes) -> ET.Element:
+    """Parse an S3 request body, stripping the S3 namespace: boto/aws-cli send
+    xmlns=http://s3.amazonaws.com/doc/2006-03-01/ and ElementTree would
+    otherwise tag every element as {ns}Name."""
+    root = ET.fromstring(body.decode())
+    for el in root.iter():
+        el.tag = re.sub(r"^\{.*\}", "", el.tag)
+    return root
+
+
+def _text(el, tag: str, default: str = "") -> str:
+    child = el.find(tag)
+    return child.text or default if child is not None else default
+
+
+class ObjectNode:
+    """cluster must provide: create_volume(name, cold), delete_volume(name),
+    volume_names(), client(name) -> FsClient, data_backend. FsCluster does."""
+
+    def __init__(self, cluster, users: dict[str, dict] | None = None,
+                 region: str = "cfs", anonymous_ok: bool = False):
+        self.cluster = cluster
+        # users: access_key -> {"secret_key": ..., "uid": ...}
+        self.users = users or {}
+        self.region = region
+        self.anonymous_ok = anonymous_ok
+        self._vols: dict[str, OSSVolume] = {}
+        self.router = self._build_router()
+
+    # -- volume plumbing ---------------------------------------------------------
+
+    def _vol(self, bucket: str) -> OSSVolume:
+        vol = self._vols.get(bucket)
+        if vol is None:
+            try:
+                fs = self.cluster.client(bucket)
+            except Exception:
+                raise S3Error(404, "NoSuchBucket", bucket) from None
+            vol = self._vols[bucket] = OSSVolume(fs, bucket)
+        return vol
+
+    def _mpu(self, bucket: str) -> MultipartManager:
+        return MultipartManager(self._vol(bucket), self.cluster.data_backend)
+
+    # -- auth --------------------------------------------------------------------
+
+    def _authenticate(self, req: Request) -> str | None:
+        """Returns the principal uid, or None for anonymous."""
+        ak = s3auth.access_key_of(req)
+        if ak is None:
+            if self.anonymous_ok or not self.users:
+                return None
+            raise S3Error(403, "AccessDenied", "anonymous access disabled")
+        user = self.users.get(ak)
+        if user is None:
+            raise S3Error(403, "InvalidAccessKeyId", ak)
+        sk = user["secret_key"]
+        authz = req.header("authorization")
+        ok = (s3auth.verify_v4(req, sk) if authz.startswith(s3auth.V4_ALGO)
+              else s3auth.verify_v2(req, sk))
+        if not ok:
+            raise S3Error(403, "SignatureDoesNotMatch")
+        return user.get("uid", ak)
+
+    def _check(self, req: Request, bucket: str, action: str, key: str = ""):
+        """Owner → policy (deny-overrides) → ACL → default-deny."""
+        principal = self._authenticate(req)
+        vol = self._vol(bucket)
+        if principal is not None and principal == self._owner(vol):
+            return principal
+        raw = vol.get_bucket_xattr(XATTR_POLICY)
+        if raw:
+            resource = f"{bucket}/{key}" if key else bucket
+            verdict = Policy.from_json(raw).evaluate(action, resource, principal)
+            if verdict == DENY:
+                raise S3Error(403, "AccessDenied", "denied by bucket policy")
+            if verdict == ALLOW:
+                return principal
+        raw = vol.get_bucket_xattr(XATTR_ACL)
+        if raw:
+            perm = "READ" if action in (ACTION_GET, ACTION_LIST) else "WRITE"
+            if ACL.from_json(raw).allows(principal, perm):
+                return principal
+        if principal is None and not self.users:
+            return None  # wide-open dev mode: no user table configured
+        raise S3Error(403, "AccessDenied")
+
+    def _owner(self, vol: OSSVolume) -> str:
+        raw = vol.get_bucket_xattr(XATTR_ACL)
+        if raw:
+            return ACL.from_json(raw).owner
+        return vol.owner
+
+    # -- router ------------------------------------------------------------------
+
+    def _build_router(self) -> Router:
+        r = Router()
+        w = self._wrap
+        # service
+        r.get("/", w(self.list_buckets))
+        # bucket sub-resources (query-matched routes bind tighter)
+        r.get("/:bucket", w(self.get_bucket_location), queries={"location": None})
+        r.get("/:bucket", w(self.get_bucket_acl), queries={"acl": None})
+        r.put("/:bucket", w(self.put_bucket_acl), queries={"acl": None})
+        r.get("/:bucket", w(self.get_bucket_policy), queries={"policy": None})
+        r.put("/:bucket", w(self.put_bucket_policy), queries={"policy": None})
+        r.delete("/:bucket", w(self.delete_bucket_policy), queries={"policy": None})
+        r.get("/:bucket", w(self.get_bucket_cors), queries={"cors": None})
+        r.put("/:bucket", w(self.put_bucket_cors), queries={"cors": None})
+        r.delete("/:bucket", w(self.delete_bucket_cors), queries={"cors": None})
+        r.get("/:bucket", w(self.get_bucket_tagging), queries={"tagging": None})
+        r.put("/:bucket", w(self.put_bucket_tagging), queries={"tagging": None})
+        r.delete("/:bucket", w(self.delete_bucket_tagging), queries={"tagging": None})
+        r.get("/:bucket", w(self.list_uploads), queries={"uploads": None})
+        r.get("/:bucket", w(self.list_objects_v2), queries={"list-type": "2"})
+        r.post("/:bucket", w(self.delete_objects), queries={"delete": None})
+        # bucket core
+        r.get("/:bucket", w(self.list_objects_v1))
+        r.put("/:bucket", w(self.create_bucket))
+        r.delete("/:bucket", w(self.delete_bucket))
+        r.head("/:bucket", w(self.head_bucket))
+        r.handle("OPTIONS", "/:bucket", w(self.preflight))
+        # object sub-resources
+        r.get("/:bucket/*key", w(self.get_object_acl), queries={"acl": None})
+        r.put("/:bucket/*key", w(self.put_object_acl), queries={"acl": None})
+        r.get("/:bucket/*key", w(self.get_object_tagging), queries={"tagging": None})
+        r.put("/:bucket/*key", w(self.put_object_tagging), queries={"tagging": None})
+        r.delete("/:bucket/*key", w(self.delete_object_tagging),
+                 queries={"tagging": None})
+        # multipart
+        r.post("/:bucket/*key", w(self.initiate_multipart), queries={"uploads": None})
+        r.put("/:bucket/*key", w(self.upload_part),
+              queries={"partNumber": None, "uploadId": None})
+        r.get("/:bucket/*key", w(self.list_parts), queries={"uploadId": None})
+        r.post("/:bucket/*key", w(self.complete_multipart), queries={"uploadId": None})
+        r.delete("/:bucket/*key", w(self.abort_multipart), queries={"uploadId": None})
+        # object core
+        r.put("/:bucket/*key", w(self.put_object))
+        r.get("/:bucket/*key", w(self.get_object))
+        r.head("/:bucket/*key", w(self.head_object))
+        r.delete("/:bucket/*key", w(self.delete_object))
+        r.handle("OPTIONS", "/:bucket/*key", w(self.preflight))
+        return r
+
+    def _wrap(self, fn):
+        def handler(req: Request):
+            try:
+                return fn(req)
+            except S3Error as e:
+                return _xml_error(e, req.path)
+            except NoSuchKey as e:
+                return _xml_error(S3Error(404, "NoSuchKey", str(e)), req.path)
+            except NoSuchUpload as e:
+                return _xml_error(S3Error(404, "NoSuchUpload", str(e)), req.path)
+            except InvalidPart as e:
+                return _xml_error(S3Error(400, "InvalidPart", str(e)), req.path)
+            except PolicyError as e:
+                return _xml_error(S3Error(400, "MalformedPolicy", str(e)), req.path)
+            except FsError as e:
+                code = "NoSuchKey" if e.code == "ENOENT" else "InternalError"
+                status = 404 if e.code == "ENOENT" else 500
+                return _xml_error(S3Error(status, code, str(e)), req.path)
+        return handler
+
+    # -- service -----------------------------------------------------------------
+
+    def list_buckets(self, req: Request):
+        self._authenticate(req)
+        names = self.cluster.volume_names()
+        buckets = "".join(
+            f"<Bucket><Name>{esc(n)}</Name><CreationDate></CreationDate></Bucket>"
+            for n in sorted(names))
+        return Response.xml(
+            "<ListAllMyBucketsResult><Buckets>"
+            f"{buckets}</Buckets></ListAllMyBucketsResult>")
+
+    # -- bucket ------------------------------------------------------------------
+
+    def create_bucket(self, req: Request):
+        principal = self._authenticate(req)
+        bucket = req.params["bucket"]
+        if bucket in self.cluster.volume_names():
+            raise S3Error(409, "BucketAlreadyExists", bucket)
+        self.cluster.create_volume(bucket, cold=True)
+        vol = self._vol(bucket)
+        canned = req.header("x-amz-acl", "private")
+        vol.set_bucket_xattr(XATTR_ACL, ACL.canned(principal or "", canned).to_json())
+        return Response(200, {"Location": f"/{bucket}"})
+
+    def head_bucket(self, req: Request):
+        self._authenticate(req)
+        self._vol(req.params["bucket"])
+        return Response(200)
+
+    def delete_bucket(self, req: Request):
+        bucket = req.params["bucket"]
+        vol = self._vol(bucket)
+        self._check(req, bucket, ACTION_DELETE)
+        if not vol.is_empty():
+            raise S3Error(409, "BucketNotEmpty", bucket)
+        self.cluster.delete_volume(bucket)
+        self._vols.pop(bucket, None)
+        return Response(204)
+
+    def get_bucket_location(self, req: Request):
+        self._check(req, req.params["bucket"], ACTION_GET)
+        self._vol(req.params["bucket"])
+        return Response.xml(
+            f"<LocationConstraint>{self.region}</LocationConstraint>")
+
+    # -- listing -----------------------------------------------------------------
+
+    def _list_common(self, req: Request, v2: bool):
+        bucket = req.params["bucket"]
+        self._check(req, bucket, ACTION_LIST)
+        vol = self._vol(bucket)
+        prefix = req.q("prefix")
+        delim = req.q("delimiter")
+        try:
+            max_keys = min(int(req.q("max-keys", "1000")), 1000)
+        except ValueError:
+            raise S3Error(400, "InvalidArgument", "max-keys") from None
+        marker = req.q("continuation-token") or req.q("start-after") if v2 \
+            else req.q("marker")
+        contents, prefixes, truncated, next_marker = vol.list_objects(
+            prefix, marker, delim, max_keys)
+        parts = [f"<Name>{esc(bucket)}</Name><Prefix>{esc(prefix)}</Prefix>",
+                 f"<MaxKeys>{max_keys}</MaxKeys>",
+                 f"<IsTruncated>{str(truncated).lower()}</IsTruncated>"]
+        if v2:
+            parts.append(f"<KeyCount>{len(contents) + len(prefixes)}</KeyCount>")
+            if truncated:
+                parts.append(
+                    f"<NextContinuationToken>{esc(next_marker)}</NextContinuationToken>")
+        elif truncated:
+            parts.append(f"<NextMarker>{esc(next_marker)}</NextMarker>")
+        for o in contents:
+            parts.append(
+                f"<Contents><Key>{esc(o['key'])}</Key><Size>{o['size']}</Size>"
+                f"<ETag>&quot;{o.get('etag', '')}&quot;</ETag>"
+                f"<LastModified>{OSSVolume.http_time(o['mtime'])}</LastModified>"
+                f"<StorageClass>STANDARD</StorageClass></Contents>")
+        for p in prefixes:
+            parts.append(f"<CommonPrefixes><Prefix>{esc(p)}</Prefix></CommonPrefixes>")
+        tag = "ListBucketResult"
+        return Response.xml(f"<{tag}>{''.join(parts)}</{tag}>")
+
+    def list_objects_v1(self, req: Request):
+        return self._list_common(req, v2=False)
+
+    def list_objects_v2(self, req: Request):
+        return self._list_common(req, v2=True)
+
+    # -- object core -------------------------------------------------------------
+
+    def put_object(self, req: Request):
+        bucket, key = req.params["bucket"], req.params["key"]
+        self._check(req, bucket, ACTION_PUT, key)
+        vol = self._vol(bucket)
+        src = req.header("x-amz-copy-source")
+        if src:
+            return self._copy_object(req, vol, key, src)
+        user_meta = {k[len("x-amz-meta-"):]: v for k, v in req.headers.items()
+                     if k.startswith("x-amz-meta-")}
+        etag = vol.put_object(key, req.body, req.header("content-type"),
+                              user_meta or None)
+        return Response(200, {"ETag": f'"{etag}"'})
+
+    def _copy_object(self, req: Request, vol: OSSVolume, key: str, src: str):
+        src = urllib.parse.unquote(src).lstrip("/")
+        src_bucket, _, src_key = src.partition("/")
+        self._check(req, src_bucket, ACTION_GET, src_key)
+        src_vol = self._vol(src_bucket)
+        info = src_vol.info(src_key)
+        data = src_vol.get_object(src_key)
+        etag = vol.put_object(key, data, info["content_type"],
+                              info["meta"] or None)
+        return Response.xml(
+            f"<CopyObjectResult><ETag>&quot;{etag}&quot;</ETag>"
+            f"<LastModified>{OSSVolume.http_time(info['mtime'])}</LastModified>"
+            f"</CopyObjectResult>")
+
+    def _object_headers(self, info: dict) -> dict:
+        h = {"ETag": f'"{info["etag"]}"',
+             "Content-Type": info["content_type"],
+             "Last-Modified": OSSVolume.http_time(info["mtime"]),
+             "Accept-Ranges": "bytes"}
+        for k, v in info["meta"].items():
+            h[f"x-amz-meta-{k}"] = v
+        return h
+
+    def get_object(self, req: Request):
+        bucket, key = req.params["bucket"], req.params["key"]
+        self._check(req, bucket, ACTION_GET, key)
+        vol = self._vol(bucket)
+        info = vol.info(key)
+        headers = self._object_headers(info)
+        rng = req.header("range")
+        if rng and rng.startswith("bytes="):
+            try:
+                lo_s, _, hi_s = rng[len("bytes="):].partition("-")
+                if lo_s == "":  # suffix form bytes=-N
+                    length = int(hi_s)
+                    lo = max(0, info["size"] - length)
+                    hi = info["size"] - 1
+                else:
+                    lo = int(lo_s)
+                    hi = int(hi_s) if hi_s else info["size"] - 1
+            except ValueError:
+                raise S3Error(416, "InvalidRange", rng) from None
+            if lo >= info["size"] or lo > hi:
+                raise S3Error(416, "InvalidRange", rng)
+            hi = min(hi, info["size"] - 1)
+            data = vol.get_object(key, lo, hi - lo + 1)
+            headers["Content-Range"] = f"bytes {lo}-{hi}/{info['size']}"
+            return Response(206, headers, data)
+        return Response(200, headers, vol.get_object(key))
+
+    def head_object(self, req: Request):
+        bucket, key = req.params["bucket"], req.params["key"]
+        self._check(req, bucket, ACTION_GET, key)
+        info = self._vol(bucket).info(key)
+        headers = self._object_headers(info)
+        headers["Content-Length"] = str(info["size"])
+        return Response(200, headers)
+
+    def delete_object(self, req: Request):
+        bucket, key = req.params["bucket"], req.params["key"]
+        self._check(req, bucket, ACTION_DELETE, key)
+        self._vol(bucket).delete_object(key)
+        return Response(204)
+
+    def delete_objects(self, req: Request):
+        bucket = req.params["bucket"]
+        self._check(req, bucket, ACTION_DELETE)
+        vol = self._vol(bucket)
+        root = _parse_xml(req.body)
+        deleted = []
+        for obj in root.iter("Object"):
+            key = _text(obj, "Key")
+            if key:
+                vol.delete_object(key)
+                deleted.append(key)
+        body = "".join(f"<Deleted><Key>{esc(k)}</Key></Deleted>" for k in deleted)
+        return Response.xml(f"<DeleteResult>{body}</DeleteResult>")
+
+    # -- acl ---------------------------------------------------------------------
+
+    def get_bucket_acl(self, req: Request):
+        bucket = req.params["bucket"]
+        self._check(req, bucket, ACTION_GET)
+        raw = self._vol(bucket).get_bucket_xattr(XATTR_ACL)
+        acl = ACL.from_json(raw) if raw else ACL(self._vol(bucket).owner)
+        return Response.xml(acl.to_xml())
+
+    def put_bucket_acl(self, req: Request):
+        bucket = req.params["bucket"]
+        principal = self._check(req, bucket, ACTION_PUT)
+        vol = self._vol(bucket)
+        canned = req.header("x-amz-acl", "private")
+        owner = self._owner(vol) or principal or ""
+        try:
+            vol.set_bucket_xattr(XATTR_ACL, ACL.canned(owner, canned).to_json())
+        except ValueError as e:
+            raise S3Error(400, "InvalidArgument", str(e)) from None
+        return Response(200)
+
+    def get_object_acl(self, req: Request):
+        bucket, key = req.params["bucket"], req.params["key"]
+        self._check(req, bucket, ACTION_GET, key)
+        vol = self._vol(bucket)
+        vol.info(key)
+        try:
+            raw = vol.fs.getxattr("/" + key.rstrip("/"), XATTR_ACL)
+            return Response.xml(ACL.from_json(raw).to_xml())
+        except FsError:
+            return Response.xml(ACL(self._owner(vol)).to_xml())
+
+    def put_object_acl(self, req: Request):
+        bucket, key = req.params["bucket"], req.params["key"]
+        principal = self._check(req, bucket, ACTION_PUT, key)
+        vol = self._vol(bucket)
+        vol.info(key)
+        canned = req.header("x-amz-acl", "private")
+        try:
+            acl = ACL.canned(self._owner(vol) or principal or "", canned)
+        except ValueError as e:
+            raise S3Error(400, "InvalidArgument", str(e)) from None
+        vol.fs.setxattr("/" + key.rstrip("/"), XATTR_ACL, acl.to_json())
+        return Response(200)
+
+    # -- policy ------------------------------------------------------------------
+
+    def get_bucket_policy(self, req: Request):
+        bucket = req.params["bucket"]
+        self._check(req, bucket, ACTION_GET)
+        raw = self._vol(bucket).get_bucket_xattr(XATTR_POLICY)
+        if not raw:
+            raise S3Error(404, "NoSuchBucketPolicy", bucket)
+        return Response(200, {"Content-Type": "application/json"}, raw)
+
+    def put_bucket_policy(self, req: Request):
+        bucket = req.params["bucket"]
+        self._check(req, bucket, ACTION_PUT)
+        policy = Policy.from_json(req.body)  # validates
+        self._vol(bucket).set_bucket_xattr(XATTR_POLICY, policy.to_json())
+        return Response(204)
+
+    def delete_bucket_policy(self, req: Request):
+        bucket = req.params["bucket"]
+        self._check(req, bucket, ACTION_DELETE)
+        self._vol(bucket).del_bucket_xattr(XATTR_POLICY)
+        return Response(204)
+
+    # -- cors --------------------------------------------------------------------
+
+    def get_bucket_cors(self, req: Request):
+        self._check(req, req.params["bucket"], ACTION_GET)
+        raw = self._vol(req.params["bucket"]).get_bucket_xattr(XATTR_CORS)
+        if not raw:
+            raise S3Error(404, "NoSuchCORSConfiguration")
+        return Response(200, {"Content-Type": "application/json"}, raw)
+
+    def put_bucket_cors(self, req: Request):
+        bucket = req.params["bucket"]
+        self._check(req, bucket, ACTION_PUT)
+        try:
+            cfg = self._parse_cors(req)
+        except (ET.ParseError, ValueError) as e:
+            raise S3Error(400, "MalformedXML", str(e)) from None
+        self._vol(bucket).set_bucket_xattr(XATTR_CORS, cfg.to_json())
+        return Response(200)
+
+    @staticmethod
+    def _parse_cors(req: Request) -> CORSConfig:
+        if req.header("content-type", "").startswith("application/json"):
+            return CORSConfig.from_json(req.body)
+        root = _parse_xml(req.body)
+        rules = []
+        from chubaofs_tpu.objectnode.cors import CORSRule
+
+        for rule in root.iter("CORSRule"):
+            rules.append(CORSRule(
+                [e.text for e in rule.findall("AllowedOrigin")],
+                [e.text for e in rule.findall("AllowedMethod")],
+                [e.text for e in rule.findall("AllowedHeader")],
+                [e.text for e in rule.findall("ExposeHeader")],
+                int(_text(rule, "MaxAgeSeconds", "0"))))
+        return CORSConfig(rules)
+
+    def delete_bucket_cors(self, req: Request):
+        self._check(req, req.params["bucket"], ACTION_DELETE)
+        self._vol(req.params["bucket"]).del_bucket_xattr(XATTR_CORS)
+        return Response(204)
+
+    def preflight(self, req: Request):
+        bucket = req.params["bucket"]
+        raw = self._vol(bucket).get_bucket_xattr(XATTR_CORS)
+        origin = req.header("origin")
+        method = req.header("access-control-request-method") or req.method
+        if not raw or not origin:
+            return Response(403)
+        headers = CORSConfig.from_json(raw).headers_for(origin, method)
+        return Response(200 if headers else 403, headers)
+
+    # -- tagging -----------------------------------------------------------------
+
+    @staticmethod
+    def _parse_tagging(body: bytes) -> dict:
+        root = _parse_xml(body)
+        return {_text(t, "Key"): _text(t, "Value") for t in root.iter("Tag")}
+
+    @staticmethod
+    def _tagging_xml(tags: dict) -> str:
+        inner = "".join(f"<Tag><Key>{esc(k)}</Key><Value>{esc(v)}</Value></Tag>"
+                        for k, v in sorted(tags.items()))
+        return f"<Tagging><TagSet>{inner}</TagSet></Tagging>"
+
+    def get_bucket_tagging(self, req: Request):
+        self._check(req, req.params["bucket"], ACTION_GET)
+        vol = self._vol(req.params["bucket"])
+        raw = vol.get_bucket_xattr("oss:tagging")
+        import json
+
+        tags = json.loads(raw) if raw else {}
+        return Response.xml(self._tagging_xml(tags))
+
+    def put_bucket_tagging(self, req: Request):
+        import json
+
+        self._check(req, req.params["bucket"], ACTION_PUT)
+        vol = self._vol(req.params["bucket"])
+        tags = self._parse_tagging(req.body)
+        vol.set_bucket_xattr("oss:tagging", json.dumps(tags).encode())
+        return Response(204)
+
+    def delete_bucket_tagging(self, req: Request):
+        self._check(req, req.params["bucket"], ACTION_DELETE)
+        self._vol(req.params["bucket"]).del_bucket_xattr("oss:tagging")
+        return Response(204)
+
+    def get_object_tagging(self, req: Request):
+        bucket, key = req.params["bucket"], req.params["key"]
+        self._check(req, bucket, ACTION_GET, key)
+        tags = self._vol(bucket).get_tagging(key)
+        return Response.xml(self._tagging_xml(tags))
+
+    def put_object_tagging(self, req: Request):
+        bucket, key = req.params["bucket"], req.params["key"]
+        self._check(req, bucket, ACTION_PUT, key)
+        self._vol(bucket).set_tagging(key, self._parse_tagging(req.body))
+        return Response(200)
+
+    def delete_object_tagging(self, req: Request):
+        bucket, key = req.params["bucket"], req.params["key"]
+        self._check(req, bucket, ACTION_DELETE, key)
+        self._vol(bucket).delete_tagging(key)
+        return Response(204)
+
+    # -- multipart ---------------------------------------------------------------
+
+    def initiate_multipart(self, req: Request):
+        bucket, key = req.params["bucket"], req.params["key"]
+        self._check(req, bucket, ACTION_PUT, key)
+        upload_id = self._mpu(bucket).initiate(key, req.header("content-type"))
+        return Response.xml(
+            f"<InitiateMultipartUploadResult><Bucket>{esc(bucket)}</Bucket>"
+            f"<Key>{esc(key)}</Key><UploadId>{upload_id}</UploadId>"
+            f"</InitiateMultipartUploadResult>")
+
+    def upload_part(self, req: Request):
+        bucket, key = req.params["bucket"], req.params["key"]
+        self._check(req, bucket, ACTION_PUT, key)
+        try:
+            part_num = int(req.q("partNumber"))
+        except ValueError:
+            raise S3Error(400, "InvalidArgument", "partNumber") from None
+        etag = self._mpu(bucket).put_part(req.q("uploadId"), part_num, req.body)
+        return Response(200, {"ETag": f'"{etag}"'})
+
+    def list_parts(self, req: Request):
+        bucket = req.params["bucket"]
+        self._check(req, bucket, ACTION_GET)
+        key, parts = self._mpu(bucket).list_parts(req.q("uploadId"))
+        inner = "".join(
+            f"<Part><PartNumber>{p['part_number']}</PartNumber>"
+            f"<ETag>&quot;{p['etag']}&quot;</ETag><Size>{p['size']}</Size></Part>"
+            for p in parts)
+        return Response.xml(
+            f"<ListPartsResult><Bucket>{esc(bucket)}</Bucket><Key>{esc(key)}</Key>"
+            f"<UploadId>{req.q('uploadId')}</UploadId>{inner}</ListPartsResult>")
+
+    def list_uploads(self, req: Request):
+        bucket = req.params["bucket"]
+        self._check(req, bucket, ACTION_LIST)
+        ups = self._mpu(bucket).list_uploads()
+        inner = "".join(
+            f"<Upload><Key>{esc(u['key'])}</Key><UploadId>{u['upload_id']}</UploadId>"
+            f"</Upload>" for u in ups)
+        return Response.xml(
+            f"<ListMultipartUploadsResult><Bucket>{esc(bucket)}</Bucket>{inner}"
+            f"</ListMultipartUploadsResult>")
+
+    def complete_multipart(self, req: Request):
+        bucket, key = req.params["bucket"], req.params["key"]
+        self._check(req, bucket, ACTION_PUT, key)
+        root = _parse_xml(req.body)
+        try:
+            spec = [(int(_text(p, "PartNumber")), _text(p, "ETag"))
+                    for p in root.iter("Part")]
+        except ValueError:
+            raise S3Error(400, "MalformedXML", "PartNumber") from None
+        final_key, etag = self._mpu(bucket).complete(req.q("uploadId"), spec)
+        return Response.xml(
+            f"<CompleteMultipartUploadResult><Bucket>{esc(bucket)}</Bucket>"
+            f"<Key>{esc(final_key)}</Key><ETag>&quot;{etag}&quot;</ETag>"
+            f"</CompleteMultipartUploadResult>")
+
+    def abort_multipart(self, req: Request):
+        bucket, key = req.params["bucket"], req.params["key"]
+        self._check(req, bucket, ACTION_DELETE, key)
+        self._mpu(bucket).abort(req.q("uploadId"))
+        return Response(204)

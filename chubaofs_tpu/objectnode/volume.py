@@ -1,0 +1,255 @@
+"""Bucket-on-volume object semantics (objectnode/fs_volume.go analog).
+
+Reference counterpart: objectnode/fs_volume.go — `Volume.PutObject` (:596)
+maps an S3 key to a filesystem path inside the bucket's volume, creating
+implicit intermediate directories; object metadata (etag, content type, user
+meta, tags, ACL) live as xattrs on the object inode; listing walks the
+directory tree in key order. Delete prunes now-empty parent directories so
+phantom CommonPrefixes don't outlive their objects.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+
+from chubaofs_tpu.sdk.fs import FsClient, FsError
+
+XATTR_ETAG = "oss:etag"
+XATTR_CONTENT_TYPE = "oss:content-type"
+XATTR_USER_META = "oss:meta"
+XATTR_TAGGING = "oss:tagging"
+XATTR_DIR_MARKER = "oss:dir"
+
+DEFAULT_CONTENT_TYPE = "application/octet-stream"
+
+
+class NoSuchKey(Exception):
+    pass
+
+
+def _etag(data: bytes) -> str:
+    return hashlib.md5(data).hexdigest()
+
+
+class OSSVolume:
+    """One bucket == one volume; verbs the S3 handlers call."""
+
+    def __init__(self, fs: FsClient, bucket: str, owner: str = ""):
+        self.fs = fs
+        self.bucket = bucket
+        self.owner = owner
+
+    # -- write -------------------------------------------------------------------
+
+    def put_object(self, key: str, data: bytes, content_type: str = "",
+                   user_meta: dict | None = None, etag: str | None = None) -> str:
+        if key.endswith("/"):
+            # directory marker object (the console/aws-cli "create folder" shape)
+            ino_path = "/" + key.rstrip("/")
+            self.fs.mkdirs(ino_path)
+            self.fs.setxattr(ino_path, XATTR_DIR_MARKER, b"1")
+            self.fs.setxattr(ino_path, XATTR_ETAG, _etag(b"").encode())
+            return _etag(b"")
+        path = "/" + key
+        parent = path.rsplit("/", 1)[0]
+        if parent:
+            self.fs.mkdirs(parent)
+        self.fs.write_file(path, data)
+        tag = etag or _etag(data)
+        self.fs.setxattr(path, XATTR_ETAG, tag.encode())
+        self.fs.setxattr(path, XATTR_CONTENT_TYPE,
+                         (content_type or DEFAULT_CONTENT_TYPE).encode())
+        if user_meta:
+            self.fs.setxattr(path, XATTR_USER_META, json.dumps(user_meta).encode())
+        return tag
+
+    # -- read --------------------------------------------------------------------
+
+    def info(self, key: str) -> dict:
+        path = "/" + key.rstrip("/")
+        try:
+            st = self.fs.stat(path)
+        except FsError:
+            raise NoSuchKey(key) from None
+        if st["is_dir"]:
+            # only explicit dir markers are objects
+            try:
+                self.fs.getxattr(path, XATTR_DIR_MARKER)
+            except FsError:
+                raise NoSuchKey(key) from None
+        out = {"key": key, "size": 0 if st["is_dir"] else st["size"],
+               "mtime": st["mtime"], "is_dir": st["is_dir"],
+               "etag": "", "content_type": DEFAULT_CONTENT_TYPE, "meta": {}}
+        for xk, field in ((XATTR_ETAG, "etag"), (XATTR_CONTENT_TYPE, "content_type")):
+            try:
+                out[field] = self.fs.getxattr(path, xk).decode()
+            except FsError:
+                pass
+        try:
+            out["meta"] = json.loads(self.fs.getxattr(path, XATTR_USER_META))
+        except FsError:
+            pass
+        return out
+
+    def get_object(self, key: str, offset: int = 0, size: int | None = None) -> bytes:
+        info = self.info(key)
+        if info["is_dir"]:
+            return b""
+        try:
+            return self.fs.read_file("/" + key, offset, size)
+        except FsError:
+            raise NoSuchKey(key) from None
+
+    # -- delete ------------------------------------------------------------------
+
+    def delete_object(self, key: str) -> None:
+        """Idempotent like S3 DeleteObject (no error on missing key)."""
+        path = "/" + key.rstrip("/")
+        try:
+            st = self.fs.stat(path)
+        except FsError:
+            return
+        try:
+            if st["is_dir"]:
+                self.fs.rmdir(path)
+            else:
+                self.fs.unlink(path)
+        except FsError:
+            return  # non-empty dir marker: S3 leaves the prefix alive
+        self._prune_empty_parents(path)
+
+    def _prune_empty_parents(self, path: str):
+        parts = [p for p in path.split("/") if p][:-1]
+        while parts:
+            parent = "/" + "/".join(parts)
+            try:
+                if self.fs.readdir(parent):
+                    return
+                # keep explicit dir markers even when empty
+                try:
+                    self.fs.getxattr(parent, XATTR_DIR_MARKER)
+                    return
+                except FsError:
+                    pass
+                self.fs.rmdir(parent)
+            except FsError:
+                return
+            parts.pop()
+
+    # -- tagging -----------------------------------------------------------------
+
+    def get_tagging(self, key: str) -> dict:
+        self.info(key)
+        try:
+            return json.loads(self.fs.getxattr("/" + key.rstrip("/"), XATTR_TAGGING))
+        except FsError:
+            return {}
+
+    def set_tagging(self, key: str, tags: dict):
+        self.info(key)
+        self.fs.setxattr("/" + key.rstrip("/"), XATTR_TAGGING,
+                         json.dumps(tags).encode())
+
+    def delete_tagging(self, key: str):
+        self.info(key)
+        self.fs.removexattr("/" + key.rstrip("/"), XATTR_TAGGING)
+
+    # -- xattr passthrough for bucket-level configs (acl/policy/cors) ------------
+
+    def get_bucket_xattr(self, key: str) -> bytes | None:
+        try:
+            return self.fs.getxattr("/", key)
+        except FsError:
+            return None
+
+    def set_bucket_xattr(self, key: str, value: bytes):
+        self.fs.setxattr("/", key, value)
+
+    def del_bucket_xattr(self, key: str):
+        self.fs.removexattr("/", key)
+
+    # -- listing -----------------------------------------------------------------
+
+    def _walk(self, dirpath: str, out: list[dict]):
+        """DFS in lexicographic order; emits files and dir-marker dirs."""
+        for name in sorted(self.fs.readdir(dirpath or "/")):
+            child = f"{dirpath}/{name}"
+            st = self.fs.stat(child)
+            key = child.lstrip("/")
+            if st["is_dir"]:
+                try:
+                    self.fs.getxattr(child, XATTR_DIR_MARKER)
+                    out.append({"key": key + "/", "size": 0, "mtime": st["mtime"]})
+                except FsError:
+                    pass
+                self._walk(child, out)
+            else:
+                out.append({"key": key, "size": st["size"], "mtime": st["mtime"]})
+
+    def list_objects(self, prefix: str = "", marker: str = "",
+                     delimiter: str = "", max_keys: int = 1000):
+        """Returns (contents, common_prefixes, is_truncated, next_marker).
+
+        Flat walk + in-memory filtering: correct for the full S3 semantics
+        (prefix, delimiter grouping, marker resume, max-keys truncation). The
+        walk starts from the deepest directory implied by the prefix so cost
+        scales with the listed subtree, not the bucket."""
+        base = ""
+        if "/" in prefix:
+            cand = prefix.rsplit("/", 1)[0]
+            try:
+                if self.fs.stat("/" + cand)["is_dir"]:
+                    base = "/" + cand
+            except FsError:
+                return [], [], False, ""
+        everything: list[dict] = []
+        try:
+            self._walk(base, everything)
+        except FsError:
+            return [], [], False, ""
+
+        contents: list[dict] = []
+        prefixes: list[str] = []
+        seen_prefixes: set[str] = set()
+        truncated = False
+        next_marker = ""
+        for obj in everything:
+            key = obj["key"]
+            if prefix and not key.startswith(prefix):
+                continue
+            if marker and key <= marker:
+                continue
+            if delimiter:
+                rest = key[len(prefix):]
+                if delimiter in rest:
+                    cp = prefix + rest.split(delimiter, 1)[0] + delimiter
+                    if cp not in seen_prefixes:
+                        if len(contents) + len(seen_prefixes) >= max_keys:
+                            truncated = True
+                            break
+                        seen_prefixes.add(cp)
+                        prefixes.append(cp)
+                        next_marker = cp  # resume point may be a prefix too
+                    continue
+            if len(contents) + len(seen_prefixes) >= max_keys:
+                truncated = True
+                break
+            # etag lazily — only for emitted keys
+            try:
+                obj = dict(obj, etag=self.fs.getxattr(
+                    "/" + key.rstrip("/"), XATTR_ETAG).decode())
+            except FsError:
+                obj = dict(obj, etag="")
+            contents.append(obj)
+            next_marker = key
+        return contents, prefixes, truncated, (next_marker if truncated else "")
+
+    def is_empty(self) -> bool:
+        names = [n for n in self.fs.readdir("/")]
+        return not names
+
+    @staticmethod
+    def http_time(ts: float) -> str:
+        return time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime(ts))

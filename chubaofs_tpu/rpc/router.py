@@ -59,6 +59,8 @@ class _Route:
     def __init__(self, method: str, pattern: str, handler, queries: dict | None):
         self.method = method
         self.parts = [p for p in pattern.split("/") if p != ""]
+        self.wildcard = (self.parts[-1][1:]
+                         if self.parts and self.parts[-1].startswith("*") else None)
         self.absolute = pattern == "/"
         self.handler = handler
         self.queries = queries or {}
@@ -73,9 +75,10 @@ class _Route:
         if self.absolute:
             return {} if not path_parts else None
         if len(path_parts) != len(self.parts):
-            # trailing :param* swallows the rest (objectnode object keys)
+            # a trailing *param swallows extra segments (objectnode object
+            # keys) but never matches empty — /b must not match /:bucket/*key
             if not (self.parts and self.parts[-1].startswith("*")
-                    and len(path_parts) >= len(self.parts) - 1):
+                    and len(path_parts) >= len(self.parts)):
                 return None
         params: dict[str, str] = {}
         for i, spec in enumerate(self.parts):
@@ -129,6 +132,10 @@ class Router:
         for route in self._routes:
             params = route.match(req.method, parts, req.query)
             if params is not None:
+                # wildcard params keep the trailing slash (S3 dir-marker keys)
+                if (route.wildcard and req.path.endswith("/")
+                        and params.get(route.wildcard)):
+                    params[route.wildcard] += "/"
                 chosen = (route, params)
                 break
 

@@ -95,12 +95,16 @@ class RPCServer:
                 payload = b"" if self.command == "HEAD" else resp.body
                 for k, v in resp.headers.items():
                     self.send_header(k, v)
-                self.send_header("Content-Length", str(len(resp.body)))
+                # a handler-set Content-Length wins (HEAD responses describe
+                # the body they didn't send)
+                if not any(k.lower() == "content-length" for k in resp.headers):
+                    self.send_header("Content-Length", str(len(resp.body)))
                 self.end_headers()
                 if payload:
                     self.wfile.write(payload)
 
             do_GET = do_PUT = do_POST = do_DELETE = do_HEAD = _serve
+            do_OPTIONS = _serve
 
         self.httpd = ThreadingHTTPServer((host, port), Handler)
         self.httpd.daemon_threads = True

@@ -72,6 +72,25 @@ class FsClient:
             raise FsError(e.code, path) from None
         return inode.ino
 
+    def mkdirs(self, path: str, mode: int = 0o755) -> int:
+        """mkdir -p (libsdk cfs_mkdirs analog); returns the leaf inode."""
+        ino = ROOT_INO
+        for part in [p for p in path.split("/") if p]:
+            try:
+                d = self.meta.lookup(ino, part)
+                if not stat_mod.S_ISDIR(d.mode):
+                    raise FsError("ENOTDIR", path)
+                ino = d.ino
+            except OpError:
+                child = self.meta.create_inode(stat_mod.S_IFDIR | mode)
+                try:
+                    self.meta.create_dentry(ino, part, child.ino, child.mode)
+                    ino = child.ino
+                except OpError:
+                    # lost a create race: take whoever won
+                    ino = self.meta.lookup(ino, part).ino
+        return ino
+
     def readdir(self, path: str) -> list[str]:
         try:
             return [d.name for d in self.meta.read_dir(self.resolve(path))]
@@ -207,3 +226,6 @@ class FsClient:
         if key not in inode.xattrs:
             raise FsError("ENODATA", key)
         return inode.xattrs[key]
+
+    def removexattr(self, path: str, key: str) -> None:
+        self.meta.remove_xattr(self.resolve(path), key)

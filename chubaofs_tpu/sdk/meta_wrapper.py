@@ -140,3 +140,54 @@ class MetaWrapper:
     def set_xattr(self, ino: int, key: str, value: bytes):
         mp = self.partition_of(ino)
         return self.submit(mp, "set_xattr", ino=ino, key=key, value=value)
+
+    def remove_xattr(self, ino: int, key: str):
+        mp = self.partition_of(ino)
+        return self.submit(mp, "remove_xattr", ino=ino, key=key)
+
+    # -- S3 multipart sessions (metanode multipart state, objectnode's backing) --
+    # upload_id embeds the owning partition so later ops route without a
+    # cluster-wide lookup: "<partition_id>.<random>".
+
+    def multipart_create(self, key: str) -> str:
+        import uuid
+
+        mp = self.tail_partition()
+        upload_id = f"{mp.partition_id}.{uuid.uuid4().hex[:16]}"
+        return self.submit(mp, "multipart_create", key=key, upload_id=upload_id)
+
+    def _multipart_partition(self, upload_id: str):
+        try:
+            pid = int(upload_id.split(".", 1)[0])
+        except ValueError:
+            raise OpError("ENOENT", f"malformed upload id {upload_id!r}") from None
+        for mp in self._view().meta_partitions:
+            if mp.partition_id == pid:
+                return mp
+        raise OpError("ENOENT", f"partition {pid} for upload {upload_id}")
+
+    def multipart_put_part(self, upload_id: str, part_num: int, location: dict):
+        mp = self._multipart_partition(upload_id)
+        return self.submit(mp, "multipart_put_part", upload_id=upload_id,
+                           part_num=part_num, location=location)
+
+    def multipart_complete(self, upload_id: str) -> dict:
+        mp = self._multipart_partition(upload_id)
+        return self.submit(mp, "multipart_complete", upload_id=upload_id)
+
+    def multipart_abort(self, upload_id: str) -> dict:
+        mp = self._multipart_partition(upload_id)
+        return self.submit(mp, "multipart_abort", upload_id=upload_id)
+
+    def multipart_get(self, upload_id: str) -> dict:
+        mp = self._multipart_partition(upload_id)
+        return self._on_partition(
+            mp, lambda n: n.multipart_get(mp.partition_id, upload_id))
+
+    def multipart_list(self) -> dict[str, dict]:
+        out: dict[str, dict] = {}
+        for mp in self._view().meta_partitions:
+            sessions = self._on_partition(
+                mp, lambda n, _mp=mp: n.multipart_list(_mp.partition_id))
+            out.update(sessions)
+        return out
