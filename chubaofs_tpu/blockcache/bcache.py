@@ -1,0 +1,273 @@
+"""Node-local block cache: LRU-on-disk manager + unix-socket service + client.
+
+Reference counterpart: blockcache/bcache — service.go:132 (unix domain socket
+listener shared by every client process on the node), manage.go:130
+(bcacheManager: blocks cached as local files keyed `volume_inode_offset`,
+size-capped LRU with free-ratio eviction), client.go (Get/Put/Evict RPCs).
+Wire format here: one JSON header line + raw data bytes, length-prefixed.
+The cold-read path docks via FsClient (sdk/data/blobstore/reader.go:30,66
+bcache hooks): read-through GET, async-ish PUT after a blobstore read.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+import socketserver
+import struct
+import threading
+
+
+class BcacheManager:
+    """Disk-backed LRU of cache blocks (manage.go:130 analog)."""
+
+    def __init__(self, cache_dir: str, capacity_bytes: int = 256 << 20,
+                 free_ratio: float = 0.15):
+        self.dir = cache_dir
+        self.capacity = capacity_bytes
+        self.free_ratio = free_ratio
+        self._lock = threading.Lock()
+        self._lru: dict[str, int] = {}  # key -> size, insertion order = LRU
+        self.used = 0
+        self.hits = 0
+        self.misses = 0
+        os.makedirs(cache_dir, exist_ok=True)
+        self._load()
+
+    def _path(self, key: str) -> str:
+        h = hashlib.sha256(key.encode()).hexdigest()
+        return os.path.join(self.dir, h[:2], h)
+
+    def _load(self):
+        """Rebuild the index from cache files surviving a daemon restart."""
+        for sub in sorted(os.listdir(self.dir)):
+            subdir = os.path.join(self.dir, sub)
+            if not os.path.isdir(subdir):
+                continue
+            for name in sorted(os.listdir(subdir)):
+                p = os.path.join(subdir, name)
+                keyfile = p + ".key"
+                if os.path.exists(keyfile):
+                    with open(keyfile, encoding="utf-8") as f:
+                        key = f.read()
+                    size = os.path.getsize(p)
+                    self._lru[key] = size
+                    self.used += size
+
+    def get(self, key: str, offset: int = 0, size: int | None = None) -> bytes | None:
+        with self._lock:
+            if key not in self._lru:
+                self.misses += 1
+                return None
+            # touch: move to MRU end
+            self._lru[key] = self._lru.pop(key)
+            self.hits += 1
+        try:
+            with open(self._path(key), "rb") as f:
+                f.seek(offset)
+                return f.read(size if size is not None else -1)
+        except OSError:
+            with self._lock:
+                size_gone = self._lru.pop(key, 0)
+                self.used -= size_gone
+            return None
+
+    def put(self, key: str, data: bytes):
+        p = self._path(key)
+        os.makedirs(os.path.dirname(p), exist_ok=True)
+        with open(p, "wb") as f:
+            f.write(data)
+        with open(p + ".key", "w", encoding="utf-8") as f:
+            f.write(key)
+        with self._lock:
+            old = self._lru.pop(key, 0)
+            self._lru[key] = len(data)
+            self.used += len(data) - old
+            evict = self._plan_eviction_locked()
+        for k in evict:
+            self._delete_files(k)
+
+    def _plan_eviction_locked(self) -> list[str]:
+        """When over capacity, free down to (1 - free_ratio) * capacity."""
+        if self.used <= self.capacity:
+            return []
+        target = int(self.capacity * (1 - self.free_ratio))
+        out = []
+        for k in list(self._lru):
+            if self.used <= target:
+                break
+            self.used -= self._lru.pop(k)
+            out.append(k)
+        return out
+
+    def evict(self, key: str):
+        with self._lock:
+            size = self._lru.pop(key, None)
+            if size is None:
+                return
+            self.used -= size
+        self._delete_files(key)
+
+    def _delete_files(self, key: str):
+        p = self._path(key)
+        for path in (p, p + ".key"):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"used": self.used, "capacity": self.capacity,
+                    "blocks": len(self._lru), "hits": self.hits,
+                    "misses": self.misses}
+
+
+# -- wire: 4-byte header length + JSON header + raw data -----------------------
+
+def _send_msg(sock: socket.socket, header: dict, data: bytes = b""):
+    h = json.dumps(header).encode()
+    sock.sendall(struct.pack("<II", len(h), len(data)) + h + data)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return buf
+
+
+def _recv_msg(sock: socket.socket) -> tuple[dict, bytes]:
+    hlen, dlen = struct.unpack("<II", _recv_exact(sock, 8))
+    header = json.loads(_recv_exact(sock, hlen).decode()) if hlen else {}
+    data = _recv_exact(sock, dlen) if dlen else b""
+    return header, data
+
+
+class BcacheService:
+    """Unix-socket daemon fronting one BcacheManager (service.go:132)."""
+
+    def __init__(self, sock_path: str, manager: BcacheManager):
+        self.sock_path = sock_path
+        self.manager = manager
+        if os.path.exists(sock_path):
+            os.unlink(sock_path)
+        mgr = manager
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                while True:
+                    try:
+                        header, data = _recv_msg(self.request)
+                    except (ConnectionError, OSError):
+                        return
+                    op = header.get("op")
+                    if op == "get":
+                        blk = mgr.get(header["key"], header.get("offset", 0),
+                                      header.get("size"))
+                        if blk is None:
+                            _send_msg(self.request, {"ok": False})
+                        else:
+                            _send_msg(self.request, {"ok": True}, blk)
+                    elif op == "put":
+                        mgr.put(header["key"], data)
+                        _send_msg(self.request, {"ok": True})
+                    elif op == "evict":
+                        mgr.evict(header["key"])
+                        _send_msg(self.request, {"ok": True})
+                    elif op == "stats":
+                        _send_msg(self.request, {"ok": True, **mgr.stats()})
+                    else:
+                        _send_msg(self.request, {"ok": False, "err": "bad op"})
+
+        class Server(socketserver.ThreadingUnixStreamServer):
+            daemon_threads = True
+
+        self.server = Server(sock_path, Handler)
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        name="bcache", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+        if os.path.exists(self.sock_path):
+            os.unlink(self.sock_path)
+
+
+class BcacheClient:
+    """Per-process client with one pooled connection (client.go analog).
+
+    cache_key(volume, ino, offset) mirrors the reference's
+    `volume_inode_offset` naming."""
+
+    def __init__(self, sock_path: str):
+        self.sock_path = sock_path
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+
+    @staticmethod
+    def cache_key(volume: str, ino: int, offset: int) -> str:
+        return f"{volume}_{ino}_{offset}"
+
+    def _conn(self) -> socket.socket:
+        if self._sock is None:
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.settimeout(5.0)
+            self._sock.connect(self.sock_path)
+        return self._sock
+
+    def _call(self, header: dict, data: bytes = b"") -> tuple[dict, bytes]:
+        with self._lock:
+            try:
+                sock = self._conn()
+                _send_msg(sock, header, data)
+                return _recv_msg(sock)
+            except (ConnectionError, OSError):
+                if self._sock is not None:
+                    self._sock.close()
+                    self._sock = None
+                raise
+
+    def get(self, key: str, offset: int = 0, size: int | None = None) -> bytes | None:
+        try:
+            header, data = self._call({"op": "get", "key": key,
+                                       "offset": offset, "size": size})
+        except (ConnectionError, OSError):
+            return None  # cache daemon down == cache miss
+        return data if header.get("ok") else None
+
+    def put(self, key: str, data: bytes) -> bool:
+        try:
+            header, _ = self._call({"op": "put", "key": key}, data)
+            return bool(header.get("ok"))
+        except (ConnectionError, OSError):
+            return False
+
+    def evict(self, key: str) -> None:
+        try:
+            self._call({"op": "evict", "key": key})
+        except (ConnectionError, OSError):
+            pass
+
+    def stats(self) -> dict | None:
+        try:
+            header, _ = self._call({"op": "stats"})
+        except (ConnectionError, OSError):
+            return None
+        return header if header.get("ok") else None
+
+    def close(self):
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None

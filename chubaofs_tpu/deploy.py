@@ -57,6 +57,10 @@ class FsCluster:
         self.datanodes: dict[int, DataNode] = {}
         self.admin_pool = ConnPool()
 
+        from chubaofs_tpu.authnode import AUTH_GROUP, AuthNode, KeystoreSM
+
+        self.keystore_sms: dict[int, KeystoreSM] = {}
+        self.authnodes: dict[int, AuthNode] = {}
         for i in range(1, n_nodes + 1):
             raft = MultiRaft(i, self.net, wal_dir=os.path.join(root, f"raft{i}"),
                              snapshot_every=512)
@@ -66,6 +70,10 @@ class FsCluster:
             raft.create_group(MASTER_GROUP, list(range(1, n_nodes + 1)), sm)
             self.masters[i] = Master(raft, sm)
             self.metanodes[i] = MetaNode(i, raft)
+            ksm = KeystoreSM()
+            raft.create_group(AUTH_GROUP, list(range(1, n_nodes + 1)), ksm)
+            self.keystore_sms[i] = ksm
+            self.authnodes[i] = AuthNode(raft, ksm)
 
         for i, m in self.masters.items():
             m.metanode_hook = self._create_meta_partition
@@ -143,6 +151,14 @@ class FsCluster:
             if m.is_leader:
                 return m
         raise MasterError("no master leader")
+
+    def authnode(self):
+        from chubaofs_tpu.authnode import AUTH_GROUP
+
+        for i, node in self.authnodes.items():
+            if self.rafts[i].is_leader(AUTH_GROUP):
+                return node
+        raise MasterError("no authnode leader")
 
     def _datanode_at(self, addr: str) -> DataNode | None:
         return next((d for d in self.datanodes.values() if d.addr == addr), None)

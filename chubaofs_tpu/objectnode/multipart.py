@@ -85,7 +85,7 @@ class MultipartManager:
         ordered = []
         md5s = b""
         for num, etag in parts_spec:
-            info = have.get(num) or have.get(str(num))
+            info = have.get(num)
             if info is None or info["etag"].strip('"') != etag.strip('"'):
                 raise InvalidPart(f"part {num}")
             ordered.append(info)
@@ -112,16 +112,14 @@ class MultipartManager:
             size += info["size"]
         fs.meta.append_obj_extents(ino, locations, size)
         fs.setxattr(path, XATTR_ETAG, final_etag.encode())
-        ct = (have.get(0) or have.get("0") or {}).get("content_type", "")
+        ct = (have.get(0) or {}).get("content_type", "")
         fs.setxattr(path, XATTR_CONTENT_TYPE, (ct or DEFAULT_CONTENT_TYPE).encode())
         # unused parts (uploaded but not listed in the complete spec) are orphan
         # data: delete them now, then drop the session
-        listed = {id(i) for i in ordered}
+        linked = {info["loc"] for info in ordered}
         session = self.meta.multipart_complete(upload_id)
-        for num, info in session["parts"].items():
-            if num in (0, "0") or id(info) in listed or "loc" not in info:
-                continue
-            if not any(info is o or info == o for o in ordered):
+        for info in session["parts"].values():
+            if "loc" in info and info["loc"] not in linked:
                 try:
                     self.data.delete(info["loc"])
                 except Exception:

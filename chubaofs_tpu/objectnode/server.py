@@ -116,8 +116,13 @@ class ObjectNode:
             raise S3Error(403, "SignatureDoesNotMatch")
         return user.get("uid", ak)
 
-    def _check(self, req: Request, bucket: str, action: str, key: str = ""):
-        """Owner → policy (deny-overrides) → ACL → default-deny."""
+    def _check(self, req: Request, bucket: str, action: str, key: str = "",
+               perm: str | None = None):
+        """Owner → policy (deny-overrides) → object ACL → bucket ACL → deny.
+
+        perm names the ACL permission to demand; defaults to READ/WRITE by
+        action. ACL mutation handlers pass READ_ACP/WRITE_ACP — a plain WRITE
+        grant must NOT allow rewriting ACLs (S3's ACP permission split)."""
         principal = self._authenticate(req)
         vol = self._vol(bucket)
         if principal is not None and principal == self._owner(vol):
@@ -130,9 +135,17 @@ class ObjectNode:
                 raise S3Error(403, "AccessDenied", "denied by bucket policy")
             if verdict == ALLOW:
                 return principal
+        if perm is None:
+            perm = "READ" if action in (ACTION_GET, ACTION_LIST) else "WRITE"
+        if key:
+            try:
+                raw = vol.fs.getxattr("/" + key.rstrip("/"), XATTR_ACL)
+                if ACL.from_json(raw).allows(principal, perm):
+                    return principal
+            except FsError:
+                pass
         raw = vol.get_bucket_xattr(XATTR_ACL)
         if raw:
-            perm = "READ" if action in (ACTION_GET, ACTION_LIST) else "WRITE"
             if ACL.from_json(raw).allows(principal, perm):
                 return principal
         if principal is None and not self.users:
@@ -402,14 +415,14 @@ class ObjectNode:
 
     def get_bucket_acl(self, req: Request):
         bucket = req.params["bucket"]
-        self._check(req, bucket, ACTION_GET)
+        self._check(req, bucket, ACTION_GET, perm="READ_ACP")
         raw = self._vol(bucket).get_bucket_xattr(XATTR_ACL)
         acl = ACL.from_json(raw) if raw else ACL(self._vol(bucket).owner)
         return Response.xml(acl.to_xml())
 
     def put_bucket_acl(self, req: Request):
         bucket = req.params["bucket"]
-        principal = self._check(req, bucket, ACTION_PUT)
+        principal = self._check(req, bucket, ACTION_PUT, perm="WRITE_ACP")
         vol = self._vol(bucket)
         canned = req.header("x-amz-acl", "private")
         owner = self._owner(vol) or principal or ""
@@ -421,7 +434,7 @@ class ObjectNode:
 
     def get_object_acl(self, req: Request):
         bucket, key = req.params["bucket"], req.params["key"]
-        self._check(req, bucket, ACTION_GET, key)
+        self._check(req, bucket, ACTION_GET, key, perm="READ_ACP")
         vol = self._vol(bucket)
         vol.info(key)
         try:
@@ -432,7 +445,7 @@ class ObjectNode:
 
     def put_object_acl(self, req: Request):
         bucket, key = req.params["bucket"], req.params["key"]
-        principal = self._check(req, bucket, ACTION_PUT, key)
+        principal = self._check(req, bucket, ACTION_PUT, key, perm="WRITE_ACP")
         vol = self._vol(bucket)
         vol.info(key)
         canned = req.header("x-amz-acl", "private")

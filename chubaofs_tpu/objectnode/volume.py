@@ -219,12 +219,21 @@ class OSSVolume:
             key = obj["key"]
             if prefix and not key.startswith(prefix):
                 continue
-            if marker and key <= marker:
+            # marker compares against the ROLLED-UP name: with a delimiter,
+            # keys that group into CommonPrefix "a/" are represented by "a/"
+            # itself, so marker="a/" (a NextMarker that was a prefix) skips
+            # the whole group instead of re-emitting it forever
+            rolled = key
+            if delimiter:
+                rest = key[len(prefix):]
+                if delimiter in rest:
+                    rolled = prefix + rest.split(delimiter, 1)[0] + delimiter
+            if marker and rolled <= marker:
                 continue
             if delimiter:
                 rest = key[len(prefix):]
                 if delimiter in rest:
-                    cp = prefix + rest.split(delimiter, 1)[0] + delimiter
+                    cp = rolled
                     if cp not in seen_prefixes:
                         if len(contents) + len(seen_prefixes) >= max_keys:
                             truncated = True

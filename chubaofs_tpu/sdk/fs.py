@@ -30,15 +30,21 @@ class FsError(Exception):
 
 class FsClient:
     def __init__(self, meta: MetaWrapper, data_backend, hot_backend=None,
-                 cold: bool = True):
+                 cold: bool = True, bcache=None):
         """Cold volumes: data_backend implements write(data)->location_json,
         read(location_json, offset, size)->bytes, delete(location_json).
         Hot volumes: hot_backend is a chubaofs_tpu.sdk.stream.HotBackend
-        (write(ino, offset, data), read(ino, offset, size), delete(ino, keys))."""
+        (write(ino, offset, data), read(ino, offset, size), delete(ino, keys)).
+        bcache: optional BcacheClient — cold reads go read-through local cache
+        (sdk/data/blobstore/reader.go:30,66 bcache hooks). Cache keys hash the
+        extent LOCATION (immutable identity), not (ino, offset): a truncate +
+        rewrite reuses offsets but never locations, so stale hits are
+        impossible by construction."""
         self.meta = meta
         self.data = data_backend
         self.hot = hot_backend
         self.cold = cold or hot_backend is None
+        self.bcache = bcache
 
     # -- path resolution --------------------------------------------------------
 
@@ -171,8 +177,28 @@ class FsClient:
                 continue
             s = max(0, offset - lo)
             e = min(ext_size, offset + size - lo)
-            out += self.data.read(ext["loc"], s, e - s)
+            out += self._read_extent(ext, s, e - s, ext_size)
         return bytes(out)
+
+    # extents above this bypass the cache: a miss would otherwise turn a tiny
+    # range read into a full-extent EC reconstruct + a cache fill that a
+    # capacity-bounded LRU evicts straight away (thrash)
+    BCACHE_MAX_EXTENT = 8 << 20
+
+    def _read_extent(self, ext: dict, start: int, length: int,
+                     ext_size: int) -> bytes:
+        """One cold extent read, through the local block cache when present."""
+        if self.bcache is None or ext_size > self.BCACHE_MAX_EXTENT:
+            return self.data.read(ext["loc"], start, length)
+        import hashlib
+
+        key = "loc_" + hashlib.sha256(ext["loc"].encode()).hexdigest()[:32]
+        blk = self.bcache.get(key, start, length)
+        if blk is not None and len(blk) == length:
+            return blk
+        whole = self.data.read(ext["loc"], 0, ext_size)
+        self.bcache.put(key, whole)
+        return whole[start:start + length]
 
     def unlink(self, path: str) -> None:
         parent, name = self._resolve_parent(path)

@@ -363,6 +363,50 @@ def test_object_tagging_roundtrip(s3):
     assert b"<Tag>" not in body
 
 
+def test_delimiter_pagination_advances_past_prefixes(s3):
+    """NextMarker that is a CommonPrefix must not re-emit the same group."""
+    req(s3, "PUT", "/pageb")
+    for k in ("a/1", "a/2", "b/1", "c.txt"):
+        req(s3, "PUT", f"/pageb/{k}", body=b"v")
+    seen_prefixes, seen_keys, marker = [], [], ""
+    for _ in range(10):
+        q = "delimiter=%2F&max-keys=1" + (
+            f"&marker={marker.replace('/', '%2F')}" if marker else "")
+        _, _, body = req(s3, "GET", "/pageb", raw_query=q)
+        root = xml_of(body)
+        seen_prefixes += [e.findtext("Prefix") for e in root.iter("CommonPrefixes")]
+        seen_keys += [e.findtext("Key") for e in root.iter("Contents")]
+        if root.findtext("IsTruncated") != "true":
+            break
+        marker = root.findtext("NextMarker")
+    else:
+        pytest.fail("pagination never terminated")
+    assert seen_prefixes == ["a/", "b/"]
+    assert seen_keys == ["c.txt"]
+
+
+def test_write_grant_cannot_rewrite_acl(s3):
+    """S3 ACP split: WRITE lets you put objects, not replace the ACL."""
+    req(s3, "PUT", "/acpb", headers={"x-amz-acl": "public-read-write"})
+    # bob can write objects...
+    assert req(s3, "PUT", "/acpb/bobfile", body=b"x", ak=AK2, sk=SK2)[0] == 200
+    # ...but cannot flip the bucket private
+    status, _, _ = req(s3, "PUT", "/acpb", headers={"x-amz-acl": "private"},
+                       raw_query="acl=", ak=AK2, sk=SK2)
+    assert status == 403
+
+
+def test_object_acl_grants_access(s3):
+    """A public-read OBJECT acl opens that object in a private bucket."""
+    req(s3, "PUT", "/oaclb")
+    req(s3, "PUT", "/oaclb/open", body=b"shared")
+    req(s3, "PUT", "/oaclb/closed", body=b"private")
+    assert req(s3, "PUT", "/oaclb/open", headers={"x-amz-acl": "public-read"},
+               raw_query="acl=")[0] == 200
+    assert req(s3, "GET", "/oaclb/open", ak=AK2, sk=SK2)[0] == 200
+    assert req(s3, "GET", "/oaclb/closed", ak=AK2, sk=SK2)[0] == 403
+
+
 def test_namespaced_xml_bodies(s3):
     """boto3-style bodies carry the S3 xmlns; parsing must still see tags."""
     req(s3, "PUT", "/nsb")
