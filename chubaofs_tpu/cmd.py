@@ -1,0 +1,537 @@
+"""Single-entry daemon with role dispatch — `python -m chubaofs_tpu.cmd -c cfg.json`.
+
+Reference counterpart: cmd/cmd.go:125-321 — one binary, a JSON config with a
+`role` field, and a switch that boots master/metanode/datanode/objectnode/
+authnode (cmd/cmd.go:175-199); blobstore/cmd/cmd.go's RegisterModule plays
+the same part for the blobstore services. Kept: JSON config file, role
+dispatch, everything network-reachable (raft rides TcpNet, metadata ops ride
+MetaService's packet TCP, admin rides the master HTTP API). Changed: no
+daemonize/fork — process supervision belongs to the operator (systemd,
+docker, a test harness); the reference's graceful-restart fd dance is covered
+by the fdstore tool instead.
+
+Self-healing placement: the master re-sends partition-create admin tasks to
+any replica whose heartbeat doesn't list the partition yet (the reference
+does the same through loadMetaPartition/checkDataPartitions sweeps,
+master/cluster.go:329-3587) — so node restarts and missed hooks converge.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import time
+
+from chubaofs_tpu.master.api_service import MasterAPI, MasterClient
+from chubaofs_tpu.master.master import MASTER_GROUP, Master, MasterSM
+from chubaofs_tpu.raft.server import MultiRaft, TickLoop
+from chubaofs_tpu.raft.transport import TcpNet
+from chubaofs_tpu.rpc.server import RPCServer
+
+HEARTBEAT_INTERVAL = 1.0
+ENSURE_INTERVAL = 2.0
+
+
+def _addr_split(addr: str) -> tuple[str, int]:
+    host, port = addr.rsplit(":", 1)
+    return host, int(port)
+
+
+def _advertise(addr: str, cfg: dict) -> str:
+    """Rewrite a wildcard bind host into a peer-dialable address. Binding
+    0.0.0.0 is how multi-host deployments listen; registering it verbatim
+    would make every peer dial its own loopback. `advertiseHost` in config
+    wins; otherwise the hostname's resolved address."""
+    host, port = addr.rsplit(":", 1)
+    if host not in ("0.0.0.0", "::", ""):
+        return addr
+    adv = cfg.get("advertiseHost")
+    if not adv:
+        import socket
+
+        try:
+            adv = socket.gethostbyname(socket.gethostname())
+        except OSError:
+            adv = "127.0.0.1"
+    return f"{adv}:{port}"
+
+
+def _log(daemon: str, msg: str) -> None:
+    print(f"[{daemon}] {msg}", file=sys.stderr, flush=True)
+
+
+def _make_net(node_id: int, peers: dict[int, str], cfg: dict) -> TcpNet:
+    """TcpNet with the cluster secret from config. Deployments binding raft
+    off-loopback MUST set `raftSecret`: frames are pickled, and the HMAC gate
+    is only as strong as the secret."""
+    secret = cfg.get("raftSecret")
+    if secret:
+        return TcpNet(node_id, peers, secret=secret.encode())
+    return TcpNet(node_id, peers)
+
+
+def _resolve_raft_peers(mc: MasterClient, net: TcpNet) -> None:
+    """Refresh peer raft addresses from the registry (raftstore/resolver.go
+    analog) so restarted nodes with new ports stay dialable."""
+    try:
+        for n in mc.get_cluster()["nodes"]:
+            if n.get("raft_addr") and n["node_id"] != net.node_id:
+                net.set_peer(n["node_id"], n["raft_addr"])
+    except Exception:
+        pass
+
+
+class _Daemon:
+    """Common lifecycle: background threads registered for stop()."""
+
+    def __init__(self):
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def _spawn(self, fn, name: str):
+        t = threading.Thread(target=fn, name=name, daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _every(self, interval: float, fn, name: str):
+        def loop():
+            last_err = ""
+            while not self._stop.wait(interval):
+                try:
+                    fn()
+                    last_err = ""
+                except Exception as e:
+                    # sweeps never kill the daemon, but persistent faults must
+                    # be visible — log each distinct error once
+                    msg = f"{type(e).__name__}: {e}"
+                    if msg != last_err:
+                        _log(name, msg)
+                        last_err = msg
+
+        self._spawn(loop, name)
+
+    def stop(self):
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=2)
+
+
+class MasterDaemon(_Daemon):
+    """Role master (master/server.go:137 Start analog)."""
+
+    def __init__(self, cfg: dict):
+        super().__init__()
+        self.node_id = int(cfg["id"])
+        raft_peers = {int(k): v for k, v in cfg["raftPeers"].items()}
+        self.peer_apis = {int(k): v for k, v in cfg.get("peerApis", {}).items()}
+        self.net = _make_net(self.node_id, raft_peers, cfg)
+        self.raft = MultiRaft(self.node_id, self.net, wal_dir=cfg.get("walDir"),
+                              snapshot_every=512)
+        self.sm = MasterSM()
+        self.raft.create_group(MASTER_GROUP, sorted(raft_peers), self.sm)
+        self.master = Master(self.raft, self.sm)
+        self.master.metanode_hook = self._meta_hook
+        self.master.datanode_hook = self._data_hook
+        self.api = MasterAPI(self.master,
+                             leader_addr_of=lambda nid: self.peer_apis.get(nid, ""))
+        host, port = _addr_split(cfg.get("listen", "127.0.0.1:0"))
+        self.server = RPCServer(self.api.router, host=host, port=port).start()
+        self.addr = self.server.addr
+        self.ticker = TickLoop([self.raft], interval=cfg.get("tickInterval", 0.02))
+        self.ticker.start()
+        self._meta_handles: dict[int, object] = {}  # node_id -> RemoteMetaNode
+        self._every(ENSURE_INTERVAL, self._ensure, f"master{self.node_id}-ensure")
+
+    # -- admin tasks to nodes (master/cluster_task.go analog) ------------------
+
+    def _meta_handle(self, node_id: int, addr: str):
+        from chubaofs_tpu.meta.service import RemoteMetaNode
+
+        h = self._meta_handles.get(node_id)
+        if h is None or h.addr != addr:  # restarted node: close + re-dial
+            if h is not None:
+                h.close()
+            h = self._meta_handles[node_id] = RemoteMetaNode(addr)
+        return h
+
+    def _raft_addrs(self, peers: list[int]) -> dict[int, str]:
+        return {p: self.sm.nodes[p].raft_addr
+                for p in peers if p in self.sm.nodes and self.sm.nodes[p].raft_addr}
+
+    def _meta_hook(self, pid: int, start: int, end: int, peers: list[int],
+                   only: int | None = None):
+        raft_addrs = self._raft_addrs(peers)
+        for peer in peers:
+            if only is not None and peer != only:
+                continue
+            node = self.sm.nodes.get(peer)
+            if node is None or not node.addr:
+                continue
+            try:
+                self._meta_handle(peer, node.addr)._call(
+                    pid, "admin_create_partition", start=start, end=end,
+                    peers=peers, raft_addrs=raft_addrs)
+            except Exception as e:
+                _log(f"master{self.node_id}",
+                     f"create mp {pid} on node {peer}: {e} (sweep retries)")
+
+    def _data_hook(self, pid: int, peers: list[int], hosts: list[str],
+                   only: int | None = None):
+        from chubaofs_tpu.proto.packet import (
+            OP_CREATE_PARTITION, Packet, RES_OK, recv_packet, send_packet)
+        import socket
+
+        raft_addrs = self._raft_addrs(peers)
+        for i, peer in enumerate(peers):
+            if only is not None and peer != only:
+                continue
+            node = self.sm.nodes.get(peer)
+            addr = node.addr if node and node.addr else (
+                hosts[i] if i < len(hosts) else "")
+            if not addr:
+                continue
+            try:
+                host, port = _addr_split(addr)
+                with socket.create_connection((host, port), timeout=3) as sock:
+                    send_packet(sock, Packet(
+                        OP_CREATE_PARTITION, partition_id=pid,
+                        arg={"peers": peers, "hosts": hosts,
+                             "raft_addrs": raft_addrs}))
+                    recv_packet(sock)
+            except Exception:
+                pass
+
+    def _ensure(self):
+        """Re-send create tasks to replicas whose heartbeats miss a partition."""
+        if not self.master.is_leader:
+            return
+        self.master.check_meta_partitions()
+        self.master.refresh_dp_hosts()
+        now = time.time()
+        for vol in list(self.sm.volumes.values()):
+            for mp in vol.meta_partitions:
+                for peer in mp.peers:
+                    n = self.sm.nodes.get(peer)
+                    if (n and n.addr and now - n.last_heartbeat < 10
+                            and mp.partition_id not in n.cursors):
+                        self._meta_hook(mp.partition_id, mp.start, mp.end,
+                                        mp.peers, only=peer)
+            for dp in vol.data_partitions:
+                for peer in dp.peers:
+                    n = self.sm.nodes.get(peer)
+                    if (n and n.addr and now - n.last_heartbeat < 10
+                            and dp.partition_id not in n.cursors):
+                        self._data_hook(dp.partition_id, dp.peers, dp.hosts,
+                                        only=peer)
+
+    def stop(self):
+        super().stop()
+        self.ticker.stop()
+        self.server.stop()
+        self.net.close()
+
+
+class MetaNodeDaemon(_Daemon):
+    """Role metanode (metanode/metanode.go analog)."""
+
+    def __init__(self, cfg: dict):
+        super().__init__()
+        from chubaofs_tpu.meta.metanode import MetaNode
+        from chubaofs_tpu.meta.service import MetaService
+
+        self.node_id = int(cfg["id"])
+        self.net = _make_net(
+            self.node_id, {self.node_id: cfg.get("raftListen", "127.0.0.1:0")},
+            cfg)
+        self._raft_addr = _advertise(self.net.listen_addr, cfg)
+        self.raft = MultiRaft(self.node_id, self.net, wal_dir=cfg.get("walDir"),
+                              snapshot_every=512)
+        self.metanode = MetaNode(self.node_id, self.raft)
+        host, port = _addr_split(cfg.get("listen", "127.0.0.1:0"))
+        self.service = MetaService(self.metanode, host=host, port=port)
+        self.addr = _advertise(self.service.addr, cfg)
+        self.mc = MasterClient(cfg["masterAddrs"])
+        self.ticker = TickLoop([self.raft], interval=cfg.get("tickInterval", 0.02))
+        self.ticker.start()
+        try:
+            self._register()
+        except Exception as e:
+            _log(f"node{self.node_id}",
+                 f"register failed: {e} (heartbeat loop retries)")
+        self._every(HEARTBEAT_INTERVAL, self._heartbeat,
+                    f"metanode{self.node_id}-hb")
+        self._wire_purge(cfg)
+        self._every(5.0, self.metanode.drain_freelists,
+                    f"metanode{self.node_id}-freelist")
+
+    def _register(self):
+        self.mc.add_node(self.node_id, "meta", self.addr,
+                         raft_addr=self._raft_addr)
+
+    def _heartbeat(self):
+        from chubaofs_tpu.master.master import MasterError
+
+        cursors = {pid: sm.cursor
+                   for pid, sm in list(self.metanode.partitions.items())}
+        try:
+            self.mc.heartbeat(self.node_id, partitions=len(cursors),
+                              cursors=cursors)
+        except MasterError:  # "unknown node": master lost state → re-register
+            self._register()
+        _resolve_raft_peers(self.mc, self.net)
+
+    def _wire_purge(self, cfg: dict):
+        """Orphan purge hooks over the wire (partition_free_list.go analog)."""
+        from chubaofs_tpu.sdk.stream import ExtentClient
+
+        access_addrs = cfg.get("accessAddrs") or []
+        ac = None
+        if access_addrs:
+            from chubaofs_tpu.blobstore.gateway import AccessClient
+
+            ac = AccessClient(access_addrs)
+
+        def all_views():
+            views = []
+            for v in self.mc.list_volumes():
+                views += self.mc.data_partitions(v["name"])
+            return views
+
+        ec = ExtentClient(all_views)
+
+        def purge_inode(inode):
+            for ext in getattr(inode, "obj_extents", []):
+                if ac is not None:
+                    ac.delete(ext["loc"])
+            keys = getattr(inode, "extents", [])
+            if keys:
+                ec.refresh()
+                ec.delete_extents(keys)
+
+        def purge_entry(entry):
+            for ext in entry.get("obj_extents", []):
+                if ac is not None:
+                    ac.delete(ext["loc"])
+            keys = entry.get("extents", [])
+            if keys:
+                ec.refresh()
+                ec.delete_extents(keys)
+
+        self.metanode.data_purge_hook = purge_inode
+        self.metanode.extent_purge_hook = purge_entry
+
+    def stop(self):
+        super().stop()
+        self.ticker.stop()
+        self.service.close()
+        self.net.close()
+
+
+class DataNodeDaemon(_Daemon):
+    """Role datanode (datanode/server.go doStart analog)."""
+
+    def __init__(self, cfg: dict):
+        super().__init__()
+        from chubaofs_tpu.data.datanode import DataNode
+
+        self.node_id = int(cfg["id"])
+        self.net = _make_net(
+            self.node_id, {self.node_id: cfg.get("raftListen", "127.0.0.1:0")},
+            cfg)
+        self._raft_addr = _advertise(self.net.listen_addr, cfg)
+        self.raft = MultiRaft(self.node_id, self.net, wal_dir=cfg.get("walDir"),
+                              snapshot_every=512)
+        self.datanode = DataNode(self.node_id, cfg.get("listen", "127.0.0.1:0"),
+                                 cfg["disks"], raft=self.raft)
+        self.datanode.start()
+        self.addr = _advertise(self.datanode.addr, cfg)
+        self.mc = MasterClient(cfg["masterAddrs"])
+        self.ticker = TickLoop([self.raft], interval=cfg.get("tickInterval", 0.02))
+        self.ticker.start()
+        try:
+            self._register()
+        except Exception as e:
+            _log(f"node{self.node_id}",
+                 f"register failed: {e} (heartbeat loop retries)")
+        self._every(HEARTBEAT_INTERVAL, self._heartbeat,
+                    f"datanode{self.node_id}-hb")
+
+    def _register(self):
+        self.mc.add_node(self.node_id, "data", self.addr,
+                         raft_addr=self._raft_addr)
+
+    def _heartbeat(self):
+        from chubaofs_tpu.master.master import MasterError
+
+        pids = {pid: 0 for pid in list(self.datanode.space.partitions)}
+        try:
+            self.mc.heartbeat(self.node_id, partitions=len(pids), cursors=pids)
+        except MasterError:
+            self._register()
+        _resolve_raft_peers(self.mc, self.net)
+
+    def stop(self):
+        super().stop()
+        self.ticker.stop()
+        self.datanode.stop()
+        self.net.close()
+
+
+class BlobstoreDaemon(_Daemon):
+    """Role blobstore: the whole EC mini-cluster + access HTTP gateway.
+
+    The reference runs access/clustermgr/proxy/blobnode/scheduler as separate
+    processes under blobstore/cmd; the rebuilt services compose in one daemon
+    here (they already talk through interfaces), fronted by the gateway."""
+
+    def __init__(self, cfg: dict):
+        super().__init__()
+        from chubaofs_tpu.blobstore.cluster import MiniCluster
+        from chubaofs_tpu.blobstore.gateway import AccessGateway
+
+        self.cluster = MiniCluster(
+            cfg["root"], n_nodes=int(cfg.get("nodes", 6)),
+            disks_per_node=int(cfg.get("disksPerNode", 2)),
+            azs=int(cfg.get("azs", 1)))
+        host, port = _addr_split(cfg.get("listen", "127.0.0.1:0"))
+        self.gateway = AccessGateway(self.cluster.access, host=host, port=port)
+        self.addr = self.gateway.addr
+        self._every(1.0, self.cluster.run_background_once, "blobstore-bg")
+
+    def stop(self):
+        super().stop()
+        self.gateway.stop()
+        self.cluster.close()
+
+
+class _MasterUserStore:
+    """Mapping face over /user/akInfo for ObjectNode authentication.
+
+    Entries expire so credential revocation at the master propagates
+    (objectnode's userInfoStore keeps the same short TTL discipline);
+    misses are negative-cached briefly to keep bad-AK floods off the master."""
+
+    TTL = 30.0
+    NEG_TTL = 5.0
+    MAX_ENTRIES = 4096  # bad-AK floods must not grow memory unboundedly
+
+    def __init__(self, mc: MasterClient):
+        self.mc = mc
+        self._cache: dict[str, tuple[float, dict | None]] = {}
+
+    def get(self, ak: str):
+        now = time.time()
+        hit = self._cache.get(ak)
+        if hit is not None and now < hit[0]:
+            return hit[1]
+        if len(self._cache) >= self.MAX_ENTRIES:
+            self._cache = {k: v for k, v in self._cache.items() if now < v[0]}
+            while len(self._cache) >= self.MAX_ENTRIES:  # all still live: drop oldest
+                self._cache.pop(next(iter(self._cache)))
+        try:
+            u = self.mc.user_by_ak(ak)
+        except Exception:
+            self._cache[ak] = (now + self.NEG_TTL, None)
+            return None
+        entry = {"secret_key": u["secret_key"], "uid": u["user_id"]}
+        self._cache[ak] = (now + self.TTL, entry)
+        return entry
+
+
+class ObjectNodeDaemon(_Daemon):
+    """Role objectnode (objectnode/server.go analog) over RemoteCluster."""
+
+    def __init__(self, cfg: dict):
+        super().__init__()
+        from chubaofs_tpu.objectnode.server import ObjectNode
+        from chubaofs_tpu.sdk.cluster import RemoteCluster
+
+        self.cluster = RemoteCluster(cfg["masterAddrs"],
+                                     access_addrs=cfg.get("accessAddrs"))
+        users = cfg.get("users")
+        if users is None:
+            users = _MasterUserStore(self.cluster.mc)
+        self.objectnode = ObjectNode(self.cluster, users=users,
+                                     region=cfg.get("region", "cfs"))
+        host, port = _addr_split(cfg.get("listen", "127.0.0.1:0"))
+        self.server = RPCServer(self.objectnode.router, host=host, port=port).start()
+        self.addr = self.server.addr
+
+    def stop(self):
+        super().stop()
+        self.server.stop()
+
+
+class AuthNodeDaemon(_Daemon):
+    """Role authnode (authnode/api_service.go analog)."""
+
+    def __init__(self, cfg: dict):
+        super().__init__()
+        from chubaofs_tpu.authnode import AUTH_GROUP, AuthNode, KeystoreSM
+        from chubaofs_tpu.authnode.api import build_router
+
+        self.node_id = int(cfg["id"])
+        raft_peers = {int(k): v for k, v in cfg["raftPeers"].items()}
+        self.net = _make_net(self.node_id, raft_peers, cfg)
+        self.raft = MultiRaft(self.node_id, self.net, wal_dir=cfg.get("walDir"),
+                              snapshot_every=512)
+        self.sm = KeystoreSM()
+        self.raft.create_group(AUTH_GROUP, sorted(raft_peers), self.sm)
+        self.authnode = AuthNode(self.raft, self.sm)
+        secret = cfg.get("adminSecret")
+        router = build_router(self.authnode,
+                              secret.encode() if secret else None)
+        host, port = _addr_split(cfg.get("listen", "127.0.0.1:0"))
+        self.server = RPCServer(router, host=host, port=port).start()
+        self.addr = self.server.addr
+        self.ticker = TickLoop([self.raft], interval=cfg.get("tickInterval", 0.02))
+        self.ticker.start()
+
+    def stop(self):
+        super().stop()
+        self.ticker.stop()
+        self.server.stop()
+        self.net.close()
+
+
+ROLES = {
+    "master": MasterDaemon,
+    "metanode": MetaNodeDaemon,
+    "datanode": DataNodeDaemon,
+    "blobstore": BlobstoreDaemon,
+    "objectnode": ObjectNodeDaemon,
+    "authnode": AuthNodeDaemon,
+}
+
+
+def start_role(cfg: dict):
+    role = cfg.get("role")
+    ctor = ROLES.get(role)
+    if ctor is None:
+        raise SystemExit(f"unknown role {role!r}; valid: {sorted(ROLES)}")
+    return ctor(cfg)
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="chubaofs-tpu",
+                                description="chubaofs-tpu server daemon")
+    p.add_argument("-c", "--config", required=True, help="JSON config file")
+    args = p.parse_args(argv)
+    with open(args.config) as f:
+        cfg = json.load(f)
+    daemon = start_role(cfg)
+    addr = getattr(daemon, "addr", "")
+    print(json.dumps({"role": cfg["role"], "addr": addr}), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        daemon.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -215,7 +215,16 @@ class DataNode:
 
     def _op_create_partition(self, pkt: Packet) -> Packet:
         a = pkt.arg
-        self.space.create_partition(pkt.partition_id, a["peers"], a["hosts"], self.raft)
+        # daemon mode: the admin task carries peer raft addresses so this
+        # node's TCP raft transport can dial them (master/cluster_task.go
+        # sends hosts the same way)
+        raft_addrs = a.get("raft_addrs") or {}
+        if raft_addrs and self.raft is not None and hasattr(self.raft.net, "set_peer"):
+            for nid, addr in raft_addrs.items():
+                self.raft.net.set_peer(int(nid), addr)
+        # idempotent: SpaceManager updates membership for an existing pid
+        self.space.create_partition(pkt.partition_id, a["peers"], a["hosts"],
+                                    self.raft)
         return pkt.reply()
 
     def _op_heartbeat(self, pkt: Packet) -> Packet:

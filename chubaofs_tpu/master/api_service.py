@@ -1,0 +1,305 @@
+"""Master HTTP admin API — the operator/client face of the resource manager.
+
+Reference counterpart: master/http_server.go:246,417 + master/api_service.go
+(5,186 LoC of HTTP/JSON handlers). Kept: the reference's URL namespace
+(/admin/*, /client/*, /dataNode/*, /metaNode/*, /user/*), its JSON envelope
+{"code": 0, "msg": "success", "data": ...}, and its leader-proxy behavior —
+a follower master answers with the leader's address so clients re-aim
+(master/http_server.go's proxy; our RPCClient follows the hint). Changed:
+handlers are thin wrappers over the Master facade; the reference's ~180
+endpoints collapse to the set the CLI/console/objectnode/SDK actually use.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict
+
+from chubaofs_tpu.master.master import MASTER_GROUP, Master, MasterError
+from chubaofs_tpu.rpc.client import RPCClient
+from chubaofs_tpu.rpc.errors import HTTPError
+from chubaofs_tpu.rpc.router import Request, Response, Router
+from chubaofs_tpu.rpc.server import RPCServer
+
+CODE_OK = 0
+CODE_ERR = 1
+CODE_NOT_LEADER = 2
+
+
+def envelope(data=None, code: int = CODE_OK, msg: str = "success") -> dict:
+    return {"code": code, "msg": msg, "data": data}
+
+
+class MasterAPI:
+    """HTTP service bound to one master replica."""
+
+    def __init__(self, master: Master, leader_addr_of=None):
+        """leader_addr_of: node_id -> admin-API address, for leader redirects."""
+        self.master = master
+        self.leader_addr_of = leader_addr_of or (lambda node_id: "")
+        self.router = self._build()
+
+    # -- plumbing -------------------------------------------------------------
+
+    def _build(self) -> Router:
+        r = Router()
+        g = r.get
+        g("/admin/getCluster", self._w(self.get_cluster, leader=False))
+        g("/admin/getIp", self._w(self.get_ip, leader=False))
+        g("/admin/createVol", self._w(self.create_vol))
+        g("/admin/deleteVol", self._w(self.delete_vol))
+        g("/admin/getVol", self._w(self.get_vol, leader=False))
+        g("/admin/listVols", self._w(self.list_vols, leader=False))
+        g("/admin/createDataPartition", self._w(self.create_dp))
+        g("/client/partitions", self._w(self.client_partitions, leader=False))
+        g("/client/metaPartitions", self._w(self.client_meta_partitions, leader=False))
+        g("/client/vol", self._w(self.get_vol, leader=False))
+        g("/dataNode/add", self._w(self.add_node_data))
+        g("/metaNode/add", self._w(self.add_node_meta))
+        g("/node/heartbeat", self._w(self.node_heartbeat))
+        g("/user/create", self._w(self.user_create))
+        g("/user/delete", self._w(self.user_delete))
+        g("/user/info", self._w(self.user_info, leader=False))
+        g("/user/akInfo", self._w(self.user_ak_info, leader=False))
+        g("/user/updatePolicy", self._w(self.user_update_policy))
+        g("/user/list", self._w(self.user_list, leader=False))
+        return r
+
+    def _w(self, fn, leader: bool = True):
+        """Wrap a handler: leader gate + MasterError → envelope."""
+
+        def handler(req: Request):
+            if leader and not self.master.is_leader:
+                lead = self.master.raft.leader_of(MASTER_GROUP)
+                addr = self.leader_addr_of(lead) if lead is not None else ""
+                return Response.json(
+                    envelope({"leader": addr}, CODE_NOT_LEADER, "not leader"),
+                    status=200)
+            try:
+                return Response.json(envelope(fn(req)))
+            except MasterError as e:
+                return Response.json(envelope(None, CODE_ERR, str(e)))
+
+        return handler
+
+    # -- handlers -------------------------------------------------------------
+
+    def get_cluster(self, req: Request):
+        sm = self.master.sm
+        return {
+            "leader_id": self.master.raft.leader_of(MASTER_GROUP),
+            "nodes": [asdict(n) for n in sm.nodes.values()],
+            "volumes": sorted(sm.volumes),
+            "users": sorted(sm.users),
+        }
+
+    def get_ip(self, req: Request):
+        return {"cluster": "chubaofs-tpu", "ip": req.remote}
+
+    def create_vol(self, req: Request):
+        name = req.q("name")
+        if not name:
+            raise MasterError("missing ?name")
+        owner = req.q("owner")
+        vol = self.master.create_volume(
+            name, owner=owner,
+            capacity=int(req.q("capacity", str(1 << 40))),
+            cold=req.q("volType") == "cold" or req.q("cold") == "true",
+            data_partitions=int(req.q("dpCount", "3")),
+        )
+        if owner and owner in self.master.sm.users:
+            self.master.set_vol_owner(owner, name, add=True)
+        return self._vol_view(vol)
+
+    def delete_vol(self, req: Request):
+        self.master.delete_volume(req.q("name"))
+        return None
+
+    def _vol_view(self, vol) -> dict:
+        d = asdict(vol)
+        # JSON has no int64 sentinel; surface the tail range end as -1
+        for mp in d["meta_partitions"]:
+            if mp["end"] >= (1 << 62):
+                mp["end"] = -1
+        return d
+
+    def get_vol(self, req: Request):
+        return self._vol_view(self.master.get_volume(req.q("name")))
+
+    def list_vols(self, req: Request):
+        return [
+            {"name": v.name, "owner": v.owner, "capacity": v.capacity,
+             "cold": v.cold, "mp_count": len(v.meta_partitions),
+             "dp_count": len(v.data_partitions)}
+            for v in self.master.sm.volumes.values()
+        ]
+
+    def create_dp(self, req: Request):
+        return asdict(self.master.create_data_partition(req.q("name")))
+
+    def client_partitions(self, req: Request):
+        return self.master.data_partition_views(req.q("name"))
+
+    def client_meta_partitions(self, req: Request):
+        vol = self.master.get_volume(req.q("name"))
+        return self._vol_view(vol)["meta_partitions"]
+
+    def _add_node(self, req: Request, kind: str):
+        node_id = int(req.q("id"))
+        self.master.register_node(node_id, kind, req.q("addr"),
+                                  raft_addr=req.q("raftAddr"))
+        return {"id": node_id}
+
+    def add_node_data(self, req: Request):
+        return self._add_node(req, "data")
+
+    def add_node_meta(self, req: Request):
+        return self._add_node(req, "meta")
+
+    def node_heartbeat(self, req: Request):
+        import json
+
+        # absent param = "no cursor report" (leaves master state alone);
+        # "{}" = an explicit empty report that WIPES the node's cursor set
+        raw = req.q("cursors", "")
+        cursors = json.loads(raw) if raw else None
+        self.master.heartbeat(int(req.q("id")),
+                              partition_count=int(req.q("partitions", "0")),
+                              cursors=cursors)
+        return None
+
+    def user_create(self, req: Request):
+        u = self.master.create_user(req.q("user"), req.q("type", "normal"))
+        return asdict(u)
+
+    def user_delete(self, req: Request):
+        self.master.delete_user(req.q("user"))
+        return None
+
+    def user_info(self, req: Request):
+        return asdict(self.master.get_user(req.q("user")))
+
+    def user_ak_info(self, req: Request):
+        return asdict(self.master.user_by_ak(req.q("ak")))
+
+    def user_update_policy(self, req: Request):
+        actions = [a for a in req.q("actions").split(",") if a]
+        u = self.master.update_user_policy(
+            req.q("user"), req.q("vol"), actions,
+            grant=req.q("grant", "true") != "false")
+        return asdict(u)
+
+    def user_list(self, req: Request):
+        return [asdict(u) for u in self.master.sm.users.values()]
+
+    def serve(self, addr: str) -> RPCServer:
+        host, port = addr.rsplit(":", 1)
+        srv = RPCServer(self.router, host=host, port=int(port))
+        srv.start()
+        return srv
+
+
+class MasterClient:
+    """sdk/master analog: follows the not-leader hint across replicas."""
+
+    def __init__(self, hosts: list[str], retries: int = 4):
+        self.rpc = RPCClient(hosts, retries=retries)
+        self.leader_hint: str | None = None
+
+    @staticmethod
+    def _path(route: str, **params) -> str:
+        """Build a query string with every value URL-encoded — volume/user
+        names must not be able to smuggle extra parameters."""
+        import urllib.parse
+
+        q = urllib.parse.urlencode(
+            {k: v for k, v in params.items() if v is not None})
+        return f"{route}?{q}" if q else route
+
+    def call(self, path: str) -> object:
+        last_msg = "no reply"
+        for _ in range(4):
+            if self.leader_hint:
+                rpc = RPCClient([self.leader_hint], retries=1)
+                try:
+                    out = rpc.get(path)
+                except (HTTPError, OSError):
+                    self.leader_hint = None
+                    continue
+            else:
+                out = self.rpc.get(path)
+            code = out.get("code")
+            if code == CODE_OK:
+                return out.get("data")
+            if code == CODE_NOT_LEADER:
+                hint = (out.get("data") or {}).get("leader") or None
+                if hint and hint != self.leader_hint:
+                    self.leader_hint = hint
+                    continue
+                self.leader_hint = None
+                import time
+
+                time.sleep(0.1)
+                continue
+            last_msg = out.get("msg", "error")
+            raise MasterError(last_msg)
+        raise MasterError(f"master unavailable: {last_msg}")
+
+    # typed helpers the CLI/SDK/objectnode use ---------------------------------
+
+    def get_cluster(self):
+        return self.call("/admin/getCluster")
+
+    def create_volume(self, name: str, owner: str = "", cold: bool = False,
+                      capacity: int = 1 << 40, dp_count: int = 3):
+        return self.call(self._path(
+            "/admin/createVol", name=name, owner=owner,
+            cold="true" if cold else "false", capacity=capacity,
+            dpCount=dp_count))
+
+    def delete_volume(self, name: str):
+        return self.call(self._path("/admin/deleteVol", name=name))
+
+    def get_volume(self, name: str):
+        return self.call(self._path("/admin/getVol", name=name))
+
+    def list_volumes(self):
+        return self.call("/admin/listVols")
+
+    def data_partitions(self, name: str):
+        return self.call(self._path("/client/partitions", name=name))
+
+    def meta_partitions(self, name: str):
+        return self.call(self._path("/client/metaPartitions", name=name))
+
+    def add_node(self, node_id: int, kind: str, addr: str, raft_addr: str = ""):
+        which = "dataNode" if kind == "data" else "metaNode"
+        return self.call(self._path(f"/{which}/add", id=node_id, addr=addr,
+                                    raftAddr=raft_addr))
+
+    def heartbeat(self, node_id: int, partitions: int = 0, cursors: dict | None = None):
+        import json
+
+        return self.call(self._path(
+            "/node/heartbeat", id=node_id, partitions=partitions,
+            cursors=None if cursors is None else json.dumps(cursors)))
+
+    def create_user(self, user: str, user_type: str = "normal"):
+        return self.call(self._path("/user/create", user=user, type=user_type))
+
+    def delete_user(self, user: str):
+        return self.call(self._path("/user/delete", user=user))
+
+    def user_info(self, user: str):
+        return self.call(self._path("/user/info", user=user))
+
+    def user_by_ak(self, ak: str):
+        return self.call(self._path("/user/akInfo", ak=ak))
+
+    def update_user_policy(self, user: str, vol: str, actions: list[str],
+                           grant: bool = True):
+        return self.call(self._path(
+            "/user/updatePolicy", user=user, vol=vol,
+            actions=",".join(actions), grant="true" if grant else "false"))
+
+    def list_users(self):
+        return self.call("/user/list")

@@ -35,6 +35,7 @@ class NodeInfo:
     node_id: int
     kind: str  # "meta" | "data"
     addr: str = ""
+    raft_addr: str = ""  # TCP raft transport address (daemon mode)
     last_heartbeat: float = 0.0
     partition_count: int = 0
     cursors: dict[int, int] = field(default_factory=dict)  # pid -> cursor (meta)
@@ -72,12 +73,27 @@ class VolumeView:
     data_partitions: list[DataPartitionView] = field(default_factory=list)
 
 
+@dataclass
+class UserInfo:
+    """master/user.go analog: an identity with S3 credentials + vol policy."""
+
+    user_id: str
+    access_key: str
+    secret_key: str
+    user_type: str = "normal"  # root | admin | normal
+    own_vols: list[str] = field(default_factory=list)
+    # vol -> granted actions, e.g. ["perm:readonly"] / ["perm:writable"]
+    authorized_vols: dict[str, list[str]] = field(default_factory=dict)
+
+
 class MasterSM(StateMachine):
     """Replicated master state (MetadataFsm + Cluster state analog)."""
 
     def __init__(self):
         self.nodes: dict[int, NodeInfo] = {}
         self.volumes: dict[str, VolumeView] = {}
+        self.users: dict[str, UserInfo] = {}  # user_id -> info
+        self.ak_index: dict[str, str] = {}  # access_key -> user_id
         self.next_id = 100  # shared id space for volumes + partitions
 
     # raft hooks -------------------------------------------------------------
@@ -92,12 +108,19 @@ class MasterSM(StateMachine):
     def snapshot(self) -> bytes:
         import pickle
 
-        return pickle.dumps((self.nodes, self.volumes, self.next_id))
+        return pickle.dumps(
+            (self.nodes, self.volumes, self.next_id, self.users, self.ak_index))
 
     def restore(self, payload: bytes) -> None:
         import pickle
 
-        self.nodes, self.volumes, self.next_id = pickle.loads(payload)
+        state = pickle.loads(payload)
+        if len(state) == 3:  # pre-user snapshot format
+            self.nodes, self.volumes, self.next_id = state
+            self.users, self.ak_index = {}, {}
+        else:
+            (self.nodes, self.volumes, self.next_id,
+             self.users, self.ak_index) = state
 
     # ops ---------------------------------------------------------------------
 
@@ -105,13 +128,18 @@ class MasterSM(StateMachine):
         self.next_id += 1
         return self.next_id
 
-    def _op_register_node(self, node_id: int, kind: str, addr: str):
+    def _op_register_node(self, node_id: int, kind: str, addr: str,
+                          raft_addr: str = ""):
         if node_id not in self.nodes:
             self.nodes[node_id] = NodeInfo(node_id, kind, addr)
         n = self.nodes[node_id]
-        n.kind = kind
+        if n.kind != kind:  # operator config error: one id, two roles
+            raise MasterError(
+                f"node id {node_id} already registered as {n.kind!r}")
         if addr:  # re-registration after restart carries the new address
             n.addr = addr
+        if raft_addr:
+            n.raft_addr = raft_addr
         n.last_heartbeat = time.time()
         return node_id
 
@@ -121,8 +149,11 @@ class MasterSM(StateMachine):
             raise MasterError(f"unknown node {node_id}")
         n.last_heartbeat = time.time()
         n.partition_count = partition_count
-        if cursors:
-            n.cursors.update({int(k): v for k, v in cursors.items()})
+        # a dict REPLACES the cursor set (even when empty — a restarted node
+        # reports no partitions, and the ensure sweep must see that to re-send
+        # create tasks); None means "no report" and leaves state alone
+        if cursors is not None:
+            n.cursors = {int(k): v for k, v in cursors.items()}
         return None
 
     def _op_create_volume(self, name: str, owner: str, capacity: int, cold: bool,
@@ -199,7 +230,55 @@ class MasterSM(StateMachine):
         vol = self.volumes.pop(name, None)
         if vol is None:
             raise MasterError(f"unknown volume {name!r}")
+        for u in self.users.values():
+            if name in u.own_vols:
+                u.own_vols.remove(name)
+            u.authorized_vols.pop(name, None)
         return vol
+
+    # -- user store (master/user.go analog) -----------------------------------
+
+    def _op_create_user(self, user_id: str, access_key: str, secret_key: str,
+                        user_type: str = "normal"):
+        if user_id in self.users:
+            raise MasterError(f"user {user_id!r} exists")
+        if access_key in self.ak_index:
+            raise MasterError("duplicate access key")
+        u = UserInfo(user_id, access_key, secret_key, user_type)
+        self.users[user_id] = u
+        self.ak_index[access_key] = user_id
+        return u
+
+    def _op_delete_user(self, user_id: str):
+        u = self.users.get(user_id)
+        if u is None:
+            raise MasterError(f"unknown user {user_id!r}")
+        if u.own_vols:
+            raise MasterError(f"user {user_id!r} still owns volumes {u.own_vols}")
+        del self.users[user_id]
+        self.ak_index.pop(u.access_key, None)
+        return None
+
+    def _op_user_own_vol(self, user_id: str, vol_name: str, add: bool):
+        u = self.users.get(user_id)
+        if u is None:
+            raise MasterError(f"unknown user {user_id!r}")
+        if add and vol_name not in u.own_vols:
+            u.own_vols.append(vol_name)
+        if not add and vol_name in u.own_vols:
+            u.own_vols.remove(vol_name)
+        return u
+
+    def _op_update_user_policy(self, user_id: str, vol_name: str,
+                               actions: list[str], grant: bool):
+        u = self.users.get(user_id)
+        if u is None:
+            raise MasterError(f"unknown user {user_id!r}")
+        if grant:
+            u.authorized_vols[vol_name] = list(actions)
+        else:
+            u.authorized_vols.pop(vol_name, None)
+        return u
 
 
 class Master:
@@ -228,12 +307,14 @@ class Master:
 
     # -- node admin -----------------------------------------------------------
 
-    def register_node(self, node_id: int, kind: str, addr: str = "") -> None:
-        self._apply("register_node", node_id=node_id, kind=kind, addr=addr)
+    def register_node(self, node_id: int, kind: str, addr: str = "",
+                      raft_addr: str = "") -> None:
+        self._apply("register_node", node_id=node_id, kind=kind, addr=addr,
+                    raft_addr=raft_addr)
 
     def heartbeat(self, node_id: int, partition_count: int = 0, cursors: dict | None = None):
         self._apply("heartbeat", node_id=node_id, partition_count=partition_count,
-                    cursors=cursors or {})
+                    cursors=cursors)
 
     # -- volume admin -----------------------------------------------------------
 
@@ -324,6 +405,43 @@ class Master:
 
     def delete_volume(self, name: str) -> None:
         self._apply("delete_volume", name=name)
+
+    # -- user admin (master/user.go analog) -----------------------------------
+
+    def create_user(self, user_id: str, user_type: str = "normal") -> UserInfo:
+        import secrets
+        import string
+
+        alphabet = string.ascii_letters + string.digits
+        ak = "".join(secrets.choice(alphabet) for _ in range(16))
+        sk = "".join(secrets.choice(alphabet) for _ in range(32))
+        self._apply("create_user", user_id=user_id, access_key=ak,
+                    secret_key=sk, user_type=user_type)
+        return self.sm.users[user_id]
+
+    def delete_user(self, user_id: str) -> None:
+        self._apply("delete_user", user_id=user_id)
+
+    def get_user(self, user_id: str) -> UserInfo:
+        u = self.sm.users.get(user_id)
+        if u is None:
+            raise MasterError(f"unknown user {user_id!r}")
+        return u
+
+    def user_by_ak(self, access_key: str) -> UserInfo:
+        uid = self.sm.ak_index.get(access_key)
+        if uid is None:
+            raise MasterError(f"unknown access key {access_key!r}")
+        return self.sm.users[uid]
+
+    def update_user_policy(self, user_id: str, vol_name: str,
+                           actions: list[str], grant: bool = True) -> UserInfo:
+        self._apply("update_user_policy", user_id=user_id, vol_name=vol_name,
+                    actions=list(actions), grant=grant)
+        return self.sm.users[user_id]
+
+    def set_vol_owner(self, user_id: str, vol_name: str, add: bool = True) -> None:
+        self._apply("user_own_vol", user_id=user_id, vol_name=vol_name, add=add)
 
     # -- background checks (scheduleTask loop analogs) --------------------------
 

@@ -73,7 +73,10 @@ class MetaNode:
     def _leader_sm(self, partition_id: int) -> MetaPartitionSM:
         sm = self.partitions.get(partition_id)
         if sm is None:
-            raise OpError("ENOENT", f"partition {partition_id} not on node {self.node_id}")
+            # distinct from a namespace ENOENT: the SDK treats this as
+            # try-the-next-replica, not file-not-found
+            raise OpError("ENOPARTITION",
+                          f"partition {partition_id} not on node {self.node_id}")
         if not self.raft.is_leader(partition_id):
             raise NotLeaderError(self.raft.leader_of(partition_id))
         return sm

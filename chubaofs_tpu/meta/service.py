@@ -1,0 +1,239 @@
+"""Metanode wire service — metadata ops over the packet TCP protocol.
+
+Reference counterpart: metanode/manager.go:103 (`HandleMetadataOperation`
+dispatching OpMeta* packets from TCP conns) + sdk/meta/operation.go (the
+client side of the same wire). Kept: request/response ride the shared binary
+`Packet` (proto/packet.go), the partition id addresses the shard, a
+not-leader reply carries the leader hint so clients re-aim
+(sdk/meta retry/leader-switch), and op payloads are JSON. Changed: one
+OP_META_OP opcode with the op name in the arg blob instead of ~40 distinct
+opcodes — the partition state machine dispatches by name already.
+
+`RemoteMetaNode` duck-types the in-process `MetaNode` surface the
+`MetaWrapper` routes over (submit_sync / lookup / get_inode / read_dir /
+multipart_*), so the SDK works unchanged against local objects or TCP.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+import socket
+import threading
+
+from chubaofs_tpu.meta.metanode import MetaNode, OpError
+from chubaofs_tpu.meta.partition import Dentry, ExtentKey, Inode
+from chubaofs_tpu.proto.packet import (
+    OP_META_OP,
+    Packet,
+    RES_ERR,
+    RES_NOT_LEADER,
+    RES_OK,
+    recv_packet,
+    send_packet,
+)
+from chubaofs_tpu.raft.server import NotLeaderError
+
+# ops served from leader state without a raft round (metanode read path)
+READ_OPS = {"lookup", "get_inode", "read_dir", "multipart_get", "multipart_list"}
+
+
+# -- value (de)serialization ---------------------------------------------------
+# Results carry dataclasses (Inode/Dentry/ExtentKey) and bytes (xattrs); JSON
+# gets a tagged encoding both ends understand.
+
+
+def enc(v):
+    if isinstance(v, Inode):
+        d = {k: enc(getattr(v, k)) for k in (
+            "ino", "mode", "uid", "gid", "size", "nlink", "ctime", "mtime",
+            "extents", "obj_extents", "xattrs")}
+        return {"__inode__": d}
+    if isinstance(v, Dentry):
+        return {"__dentry__": {"parent": v.parent, "name": v.name,
+                               "ino": v.ino, "mode": v.mode}}
+    if isinstance(v, ExtentKey):
+        return {"__ek__": {"file_offset": v.file_offset, "size": v.size,
+                           "partition_id": v.partition_id,
+                           "extent_id": v.extent_id,
+                           "extent_offset": v.extent_offset}}
+    if isinstance(v, (bytes, bytearray)):
+        return {"__bytes__": base64.b64encode(bytes(v)).decode()}
+    if isinstance(v, tuple):
+        return {"__tuple__": [enc(x) for x in v]}
+    if isinstance(v, list):
+        return [enc(x) for x in v]
+    if isinstance(v, dict):
+        return {k: enc(x) for k, x in v.items()}
+    return v
+
+
+def dec(v):
+    if isinstance(v, dict):
+        if "__inode__" in v:
+            d = {k: dec(x) for k, x in v["__inode__"].items()}
+            return Inode(**d)
+        if "__dentry__" in v:
+            return Dentry(**v["__dentry__"])
+        if "__ek__" in v:
+            return ExtentKey(**v["__ek__"])
+        if "__bytes__" in v:
+            return base64.b64decode(v["__bytes__"])
+        if "__tuple__" in v:
+            return tuple(dec(x) for x in v["__tuple__"])
+        return {k: dec(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [dec(x) for x in v]
+    return v
+
+
+class MetaService:
+    """TCP front of one MetaNode (manager.go dispatch analog)."""
+
+    def __init__(self, metanode: MetaNode, host: str = "127.0.0.1", port: int = 0):
+        self.metanode = metanode
+        self.listener = socket.create_server((host, port))
+        self.addr = f"{host}:{self.listener.getsockname()[1]}"
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept, daemon=True)
+        self._thread.start()
+
+    def _accept(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self.listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn: socket.socket):
+        try:
+            while not self._stop.is_set():
+                pkt = recv_packet(conn)
+                send_packet(conn, self._handle(pkt))
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _handle(self, pkt: Packet) -> Packet:
+        if pkt.opcode != OP_META_OP:
+            return pkt.reply(RES_ERR, arg={"error": f"bad opcode {pkt.opcode:#x}"})
+        op = pkt.arg.get("op", "")
+        args = dec(json.loads(pkt.data.decode())) if pkt.data else {}
+        pid = pkt.partition_id
+        try:
+            if op == "admin_create_partition":
+                # node-level admin task from the master (cluster_task.go
+                # analog); raft_addrs lets this node's TcpNet dial peers
+                raft_addrs = args.pop("raft_addrs", None) or {}
+                if hasattr(self.metanode.raft.net, "set_peer"):
+                    for nid, addr in raft_addrs.items():
+                        self.metanode.raft.net.set_peer(int(nid), addr)
+                if pid not in self.metanode.partitions:
+                    self.metanode.create_partition(pid, **args)
+                return pkt.reply(RES_OK, data=b"null")
+            if op == "admin_partitions":
+                out = sorted(self.metanode.partitions)
+                return pkt.reply(RES_OK, data=json.dumps(out).encode())
+            if op in READ_OPS:
+                out = getattr(self.metanode, op)(pid, **args)
+            else:
+                out = self.metanode.submit_sync(pid, op, **args)
+            return pkt.reply(RES_OK, data=json.dumps(enc(out)).encode())
+        except NotLeaderError as e:
+            return pkt.reply(RES_NOT_LEADER, arg={"leader": e.leader})
+        except OpError as e:
+            return pkt.reply(RES_ERR, arg={"code": e.code, "error": str(e)})
+        except Exception as e:  # never kill the conn on a handler bug
+            return pkt.reply(RES_ERR, arg={"code": "EIO",
+                                           "error": f"{type(e).__name__}: {e}"})
+
+    def close(self):
+        self._stop.set()
+        try:
+            self.listener.close()
+        except OSError:
+            pass
+
+
+class RemoteMetaNode:
+    """Client handle speaking MetaService's wire; MetaNode duck-type.
+
+    One pooled connection per handle; MetaWrapper's leader-retry logic drives
+    which node gets asked (sdk/meta/operation.go's sendToMetaPartition).
+    """
+
+    def __init__(self, addr: str, conn_pool=None, timeout: float = 10.0):
+        self.addr = addr
+        self.timeout = timeout
+        self.pool = conn_pool
+        self._local = threading.local()
+
+    def _conn(self) -> socket.socket:
+        sock = getattr(self._local, "sock", None)
+        if sock is None:
+            host, port = self.addr.rsplit(":", 1)
+            sock = socket.create_connection((host, int(port)), timeout=self.timeout)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._local.sock = sock
+        return sock
+
+    def _drop_conn(self):
+        sock = getattr(self._local, "sock", None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+            self._local.sock = None
+
+    def _call(self, pid: int, op: str, **args):
+        pkt = Packet(opcode=OP_META_OP, partition_id=pid, arg={"op": op},
+                     data=json.dumps(enc(args)).encode())
+        # connect failures are ECONN (nothing was sent — always safe to retry
+        # elsewhere); failures after send are EIO (the op may have applied, so
+        # only idempotent ops retry — sdk/meta's same distinction)
+        try:
+            sock = self._conn()
+        except (ConnectionError, OSError) as e:
+            self._drop_conn()
+            raise OpError("ECONN", f"metanode {self.addr}: {e}") from None
+        try:
+            send_packet(sock, pkt)
+            resp = recv_packet(sock)
+        except (ConnectionError, OSError) as e:
+            self._drop_conn()
+            raise OpError("EIO", f"metanode {self.addr}: {e}") from None
+        if resp.result == RES_NOT_LEADER:
+            raise NotLeaderError(resp.arg.get("leader"))
+        if resp.result != RES_OK:
+            raise OpError(resp.arg.get("code", "EIO"), resp.arg.get("error", "error"))
+        return dec(json.loads(resp.data.decode())) if resp.data else None
+
+    # -- MetaNode surface ------------------------------------------------------
+
+    def submit_sync(self, partition_id: int, op: str, timeout: float = 5.0, **args):
+        return self._call(partition_id, op, **args)
+
+    def lookup(self, partition_id: int, parent: int, name: str):
+        return self._call(partition_id, "lookup", parent=parent, name=name)
+
+    def get_inode(self, partition_id: int, ino: int):
+        return self._call(partition_id, "get_inode", ino=ino)
+
+    def read_dir(self, partition_id: int, parent: int):
+        return self._call(partition_id, "read_dir", parent=parent)
+
+    def multipart_get(self, partition_id: int, upload_id: str):
+        return self._call(partition_id, "multipart_get", upload_id=upload_id)
+
+    def multipart_list(self, partition_id: int):
+        return self._call(partition_id, "multipart_list")
+
+    def close(self):
+        self._drop_conn()

@@ -37,29 +37,61 @@ class MetaWrapper:
 
     # -- leader-retry op execution ---------------------------------------------
 
-    def _on_partition(self, mp: MetaPartitionView, fn):
-        """Run fn(metanode) on the partition's leader, retrying peers."""
-        order = [mp.leader] if mp.leader in mp.peers else []
-        order += [p for p in mp.peers if p not in order]
+    # a fresh partition's raft group needs an election round before it serves;
+    # the reference client retries for much longer (sdk/meta/operation.go's
+    # SendToMetaPartitionWithTimeOut loop)
+    RETRY_WINDOW = 10.0
+    RETRY_SLEEP = 0.1
+
+    def _on_partition(self, mp: MetaPartitionView, fn, idempotent: bool = True):
+        """Run fn(metanode) on the partition's leader, retrying peers and
+        waiting out elections (sdk/meta retry/leader-switch).
+
+        ECONN (connect failed: nothing sent) and ENOPARTITION (replica not
+        hosting the shard) always re-aim at another peer. EIO (connection
+        died AFTER the request went out) retries only when `idempotent` —
+        a mutation may have applied before the reply was lost, and blindly
+        re-submitting turns success into EEXIST/ENOENT."""
+        import time
+
+        RETRYABLE = ("ECONN", "ENOPARTITION") + (("EIO",) if idempotent else ())
+
+        deadline = time.time() + self.RETRY_WINDOW
         last: Exception | None = None
-        for peer in order:
-            node = self.metanodes.get(peer)
-            if node is None:
-                continue
-            try:
-                return fn(node)
-            except NotLeaderError as e:
-                last = e
-                if e.leader in mp.peers and e.leader != peer:
-                    try:
-                        return fn(self.metanodes[e.leader])
-                    except NotLeaderError as e2:
-                        last = e2
+        while True:
+            order = [mp.leader] if mp.leader in mp.peers else []
+            order += [p for p in mp.peers if p not in order]
+            for peer in order:
+                node = self.metanodes.get(peer)
+                if node is None:
+                    continue
+                try:
+                    return fn(node)
+                except NotLeaderError as e:
+                    last = e
+                    hinted = self.metanodes.get(e.leader) if e.leader in mp.peers else None
+                    if hinted is not None and e.leader != peer:
+                        try:
+                            return fn(hinted)
+                        except NotLeaderError as e2:
+                            last = e2
+                        except OpError as e2:
+                            if e2.code not in RETRYABLE:
+                                raise
+                            last = e2
+                except OpError as e:
+                    if e.code not in RETRYABLE:
+                        raise
+                    last = e
+            if time.time() >= deadline:
+                break
+            time.sleep(self.RETRY_SLEEP)
         raise last or MasterError(f"partition {mp.partition_id}: no leader reachable")
 
     def submit(self, mp: MetaPartitionView, op: str, **args):
         return self._on_partition(
-            mp, lambda node: node.submit_sync(mp.partition_id, op, **args)
+            mp, lambda node: node.submit_sync(mp.partition_id, op, **args),
+            idempotent=False,
         )
 
     # -- the ll API (api.go analogs) -------------------------------------------
@@ -67,7 +99,8 @@ class MetaWrapper:
     def create_inode(self, mode: int, uid: int = 0, gid: int = 0):
         mp = self.tail_partition()
         return self._on_partition(
-            mp, lambda n: n.submit_sync(mp.partition_id, "create_inode", mode=mode, uid=uid, gid=gid)
+            mp, lambda n: n.submit_sync(mp.partition_id, "create_inode", mode=mode, uid=uid, gid=gid),
+            idempotent=False,
         )
 
     def create_dentry(self, parent: int, name: str, ino: int, mode: int):
