@@ -3,15 +3,21 @@
 Reference counterpart: blobstore/blobnode (disks -> chunks -> shards; append-only
 chunk datafiles with per-shard headers and crc32block framing,
 core/storage/datafile.go:356,416; RocksDB shard metadb; punch-hole GC,
-core/blobfile.go:83). This implementation keeps the same on-disk contracts —
-append-only data files, block-CRC framing, a persistent shard index, hole
-punching on delete — with a Python engine (the kvstore moves to the C++ runtime
-library as it lands).
+core/blobfile.go:83). Same on-disk contracts — append-only data files,
+block-CRC framing, a persistent shard index, hole punching on delete — with
+the shard index in the native libcfskv engine (utils/kvstore), exactly the
+role RocksDB plays under the reference blobnode.
 
 Layout on disk:
     <root>/superblock.json                 disk identity + chunk registry
     <root>/chunks/<chunk_id>.data          append-only shard records
-    <root>/chunks/<chunk_id>.idx           shard index WAL (json lines)
+    <root>/metadb/                         per-disk shard index (libcfskv — the
+                                           native KV engine standing in for the
+                                           reference's RocksDB metadb,
+                                           blobnode/db/metadb.go); keys
+                                           s/<chunk_id>/<bid> -> ShardMeta json.
+                                           Legacy <chunk_id>.idx JSON-line WALs
+                                           migrate into the metadb on open.
 
 Shard record in a chunk datafile:
     [32B header: magic, bid, vuid, payload_len, header_crc]
@@ -29,6 +35,7 @@ import zlib
 from dataclasses import dataclass
 
 from chubaofs_tpu.utils import crc32block
+from chubaofs_tpu.utils.kvstore import open_kv
 
 MAGIC = 0x73686472  # "shdr"
 _HEADER = struct.Struct("<IQQQI")  # magic, bid, vuid, payload_len, crc-of-header
@@ -76,36 +83,46 @@ class ShardMeta:
 class Chunk:
     """One append-only chunk datafile + its shard index."""
 
-    def __init__(self, path: str, chunk_id: str, max_size: int):
+    def __init__(self, path: str, chunk_id: str, max_size: int, metadb):
         self.chunk_id = chunk_id
         self.max_size = max_size
         self._data_path = path + ".data"
-        self._idx_path = path + ".idx"
+        self._idx_path = path + ".idx"  # legacy json-line WAL (migrated)
+        self._db = metadb
         self._lock = threading.Lock()
         self.shards: dict[int, ShardMeta] = {}
         self._load()
         self._f = open(self._data_path, "r+b")
-        self._idx = open(self._idx_path, "a")
         self._size = os.path.getsize(self._data_path)
 
+    def _key(self, bid: int) -> bytes:
+        # fixed-width decimal keeps the metadb's byte order == bid order
+        return f"s/{self.chunk_id}/{bid:020d}".encode()
+
     def _load(self):
-        for p in (self._data_path, self._idx_path):
-            if not os.path.exists(p):
-                open(p, "ab").close()
-        with open(self._idx_path) as f:
-            for line in f:
-                if not line.strip():
-                    continue
-                rec = json.loads(line)
-                meta = ShardMeta(**rec)
-                if meta.status == STATUS_DELETED:
-                    self.shards.pop(meta.bid, None)
-                else:
-                    self.shards[meta.bid] = meta
+        if not os.path.exists(self._data_path):
+            open(self._data_path, "ab").close()
+        if os.path.exists(self._idx_path):  # migrate a legacy index WAL
+            with open(self._idx_path) as f:
+                for line in f:
+                    if not line.strip():
+                        continue
+                    meta = ShardMeta(**json.loads(line))
+                    if meta.status == STATUS_DELETED:
+                        self._db.delete(self._key(meta.bid))
+                    else:
+                        self._db.put(self._key(meta.bid),
+                                     json.dumps(meta.__dict__).encode())
+            os.replace(self._idx_path, self._idx_path + ".migrated")
+        for _, v in self._db.scan(prefix=f"s/{self.chunk_id}/".encode()):
+            meta = ShardMeta(**json.loads(v))
+            self.shards[meta.bid] = meta
 
     def _log_idx(self, meta: ShardMeta):
-        self._idx.write(json.dumps(meta.__dict__) + "\n")
-        self._idx.flush()
+        if meta.status == STATUS_DELETED:
+            self._db.delete(self._key(meta.bid))
+        else:
+            self._db.put(self._key(meta.bid), json.dumps(meta.__dict__).encode())
 
     @property
     def used(self) -> int:
@@ -176,7 +193,6 @@ class Chunk:
 
     def close(self):
         self._f.close()
-        self._idx.close()
 
 
 class Disk:
@@ -190,6 +206,7 @@ class Disk:
         self.chunk_size = chunk_size or self.DEFAULT_CHUNK_SIZE
         os.makedirs(os.path.join(root, "chunks"), exist_ok=True)
         self._sb_path = os.path.join(root, "superblock.json")
+        self.metadb = open_kv(os.path.join(root, "metadb"))
         self._lock = threading.Lock()
         self.chunks: dict[str, Chunk] = {}
         self._load()
@@ -202,7 +219,8 @@ class Disk:
             self.chunk_size = sb["chunk_size"]
             for cid in sb["chunks"]:
                 self.chunks[cid] = Chunk(
-                    os.path.join(self.root, "chunks", cid), cid, self.chunk_size
+                    os.path.join(self.root, "chunks", cid), cid,
+                    self.chunk_size, self.metadb
                 )
         else:
             self._persist()
@@ -224,7 +242,8 @@ class Disk:
         with self._lock:
             if chunk_id in self.chunks:
                 return self.chunks[chunk_id]
-            c = Chunk(os.path.join(self.root, "chunks", chunk_id), chunk_id, self.chunk_size)
+            c = Chunk(os.path.join(self.root, "chunks", chunk_id), chunk_id,
+                      self.chunk_size, self.metadb)
             self.chunks[chunk_id] = c
             self._persist()
             return c
@@ -235,6 +254,11 @@ class Disk:
             "chunks": len(self.chunks),
             "used": sum(c.used for c in self.chunks.values()),
         }
+
+    def close(self):
+        for c in self.chunks.values():
+            c.close()
+        self.metadb.close()
 
 
 class BlobNode:
@@ -302,3 +326,7 @@ class BlobNode:
             "node_id": self.node_id,
             "disks": [d.stats() for d in self.disks.values()],
         }
+
+    def close(self):
+        for d in self.disks.values():
+            d.close()

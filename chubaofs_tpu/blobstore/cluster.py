@@ -61,3 +61,6 @@ class MiniCluster:
 
     def close(self):
         self.codec.close()
+        for node in self.nodes.values():
+            node.close()
+        self.cm.close()

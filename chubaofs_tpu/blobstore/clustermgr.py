@@ -90,37 +90,64 @@ class ClusterMgr:
         self.services: dict[str, list[str]] = {}
         self.config: dict[str, str] = {}
         self._data_dir = data_dir
-        self._wal = None
-        self._wal_id = 0
+        self._db = None
+        self._seq = 0  # last applied wal sequence
         if data_dir:
             os.makedirs(data_dir, exist_ok=True)
+            from chubaofs_tpu.utils.kvstore import open_kv
+
+            self._db = open_kv(os.path.join(data_dir, "kv"))
             self._load()
-            self._wal = open(self._wal_path(self._wal_id), "a")
 
-    # -- persistence (WAL + snapshot; raftserver snapshot analog) -----------
+    # -- persistence (state in the native kvstore, the RocksDB role of
+    # blobstore/common/kvstore under clustermgr) ----------------------------
     #
-    # The WAL is rotated by id and the snapshot records which WAL id follows
-    # it, so a crash anywhere in checkpoint() never replays ops the snapshot
-    # already contains: the loader replays exactly the WAL named by the
-    # snapshot it restored.
+    # Keys: "snap" (json state) + "snap_seq" written atomically in one batch,
+    # "w/<seq>" for WAL entries after the snapshot. A crash anywhere leaves
+    # either the old snapshot + its WAL tail or the new snapshot with the
+    # old WAL keys deleted in the same atomic batch — never a double replay.
 
-    def _wal_path(self, wal_id: int) -> str:
-        return os.path.join(self._data_dir, f"wal-{wal_id}.jsonl")
+    @staticmethod
+    def _wal_key(seq: int) -> bytes:
+        return b"w/%020d" % seq
 
     def _load(self):
+        self._migrate_legacy()
+        snap = self._db.get(b"snap")
+        if snap is not None:
+            self._seq = int(self._db.get(b"snap_seq") or b"0")
+            self._restore(json.loads(snap))
+        for k, v in self._db.scan(prefix=b"w/", start=self._wal_key(self._seq + 1)):
+            op, args = json.loads(v)
+            self._apply(op, args, replay=True)
+            self._seq = int(k[2:])
+
+    def _migrate_legacy(self):
+        """One-time import of the earlier snapshot.json + wal-N.jsonl files."""
         snap = os.path.join(self._data_dir, "snapshot.json")
+        legacy_wals = sorted(
+            f for f in os.listdir(self._data_dir)
+            if f.startswith("wal-") and f.endswith(".jsonl"))
+        if not os.path.exists(snap) and not legacy_wals:
+            return
+        wal_id = 0
         if os.path.exists(snap):
             with open(snap) as f:
                 payload = json.load(f)
-            self._wal_id = payload.get("wal_id", 0)
+            wal_id = payload.get("wal_id", 0)
             self._restore(payload["state"])
-        wal = self._wal_path(self._wal_id)
+        wal = os.path.join(self._data_dir, f"wal-{wal_id}.jsonl")
         if os.path.exists(wal):
             with open(wal) as f:
                 for line in f:
                     if line.strip():
                         op, args = json.loads(line)
                         self._apply(op, args, replay=True)
+        self._db.write_batch(puts=[(b"snap", json.dumps(self.snapshot()).encode()),
+                                   (b"snap_seq", b"0")])
+        for f in legacy_wals + (["snapshot.json"] if os.path.exists(snap) else []):
+            os.replace(os.path.join(self._data_dir, f),
+                       os.path.join(self._data_dir, f + ".migrated"))
 
     def snapshot(self) -> dict:
         with self._lock:
@@ -146,35 +173,30 @@ class ClusterMgr:
         self.config = dict(snap["config"])
 
     def checkpoint(self):
-        """Write a snapshot naming the NEXT WAL, then switch to it.
-
-        Crash-safe at every step: before the snapshot replace, the old
-        snapshot + old (intact) WAL load; after it, the new snapshot + the new
-        (empty) WAL load. Old WALs are pruned last."""
-        if not self._data_dir:
+        """Fold the WAL into a fresh snapshot in ONE atomic kv batch: the new
+        snapshot, its sequence floor, and the deletion of every folded WAL
+        entry land together or not at all (RocksDB checkpoint discipline)."""
+        if not self._db:
             return
         with self._lock:
-            next_id = self._wal_id + 1
-            open(self._wal_path(next_id), "a").close()  # ensure it exists first
-            tmp = os.path.join(self._data_dir, "snapshot.json.tmp")
-            with open(tmp, "w") as f:
-                json.dump({"wal_id": next_id, "state": self.snapshot()}, f)
-            os.replace(tmp, os.path.join(self._data_dir, "snapshot.json"))
-            self._wal.close()
-            self._wal = open(self._wal_path(next_id), "a")
-            old, self._wal_id = self._wal_id, next_id
-            try:
-                os.remove(self._wal_path(old))
-            except OSError:
-                pass
+            wal_keys = [k for k, _ in self._db.scan(prefix=b"w/")]
+            self._db.write_batch(
+                puts=[(b"snap", json.dumps(self.snapshot()).encode()),
+                      (b"snap_seq", str(self._seq).encode())],
+                deletes=wal_keys)
 
     def _apply(self, op: str, args: dict, replay: bool = False):
         handler = getattr(self, "_op_" + op)
         out = handler(**args)
-        if self._wal and not replay:
-            self._wal.write(json.dumps([op, args]) + "\n")
-            self._wal.flush()
+        if self._db and not replay:
+            self._seq += 1
+            self._db.put(self._wal_key(self._seq), json.dumps([op, args]).encode())
         return out
+
+    def close(self):
+        if self._db is not None:
+            self._db.close()
+            self._db = None
 
     def apply(self, op: str, args: dict):
         with self._lock:

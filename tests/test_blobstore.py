@@ -178,12 +178,14 @@ def test_clustermgr_persistence(tmp_path, rng):
     a, b = cm1.alloc_scope("bid", 10)
     cm1.checkpoint()
     cm1.set_config("balance", "on")
+    cm1.close()
 
     cm2 = ClusterMgr(str(tmp_path / "cm"))
     assert cm2.get_volume(vol.vid).code_mode == int(CodeMode.EC3P3)
     a2, _ = cm2.alloc_scope("bid", 1)
     assert a2 == b + 1
     assert cm2.get_config("balance") == "on"
+    cm2.close()
 
 
 def test_vuid_roundtrip():
@@ -200,6 +202,7 @@ def test_blobnode_restart_recovers_index(tmp_path, rng):
     n1.create_vuid(make_vuid(1, 0))
     payload = blob_bytes(rng, 100_000)
     n1.put_shard(make_vuid(1, 0), 42, payload)
+    n1.close()
 
     n2 = BlobNode(node_id=1, disk_roots=roots)
     assert n2.get_shard(make_vuid(1, 0), 42) == payload
@@ -282,26 +285,52 @@ def test_chunk_reput_replaces_record(tmp_path, rng):
     n1.put_shard(vuid, 5, b"new" * 1000)
     assert n1.get_shard(vuid, 5) == b"new" * 1000
     assert len(n1.list_shards(vuid)) == 1
-    # survives reopen (index WAL replays to the newest record)
+    # survives reopen (the shard metadb replays to the newest record)
+    n1.close()
     n2 = BlobNode(node_id=1, disk_roots=[str(tmp_path / "d0")])
     assert n2.get_shard(vuid, 5) == b"new" * 1000
 
 
 def test_checkpoint_wal_rotation(tmp_path):
-    """Ops after a checkpoint land in the NEXT wal; restart applies each once."""
-    import os
+    """Checkpoint folds the WAL into the snapshot; restart applies each op
+    exactly once (kvstore-backed persistence, common/kvstore role)."""
     from chubaofs_tpu.blobstore.clustermgr import ClusterMgr
 
     cm = ClusterMgr(str(tmp_path / "cm"))
     cm.register_disk(1, node_id=1)
     cm.checkpoint()
+    assert cm._db.scan(prefix=b"w/") == []  # folded into the snapshot
     cm.alloc_scope("bid", 5)
-    assert os.path.exists(tmp_path / "cm" / "wal-1.jsonl")
-    assert not os.path.exists(tmp_path / "cm" / "wal-0.jsonl")
+    assert len(cm._db.scan(prefix=b"w/")) == 1  # post-checkpoint op in the WAL
+    cm.close()
 
     cm2 = ClusterMgr(str(tmp_path / "cm"))
     first, _ = cm2.alloc_scope("bid", 1)
     assert first == 6  # 5 allocated exactly once, not replayed twice
+    cm2.close()
+
+
+def test_clustermgr_legacy_migration(tmp_path):
+    """Pre-kvstore snapshot.json + wal-N.jsonl dirs import cleanly."""
+    import json
+    import os
+    from chubaofs_tpu.blobstore.clustermgr import ClusterMgr
+
+    d = tmp_path / "cm"
+    os.makedirs(d)
+    legacy = ClusterMgr(None)  # build a state in memory to snapshot
+    legacy.register_disk(1, node_id=1)
+    with open(d / "snapshot.json", "w") as f:
+        json.dump({"wal_id": 3, "state": legacy.snapshot()}, f)
+    with open(d / "wal-3.jsonl", "w") as f:
+        f.write(json.dumps(["alloc_scope", {"name": "bid", "count": 4}]) + "\n")
+
+    cm = ClusterMgr(str(d))
+    assert 1 in cm.disks
+    first, _ = cm.alloc_scope("bid", 1)
+    assert first == 5  # the 4 legacy WAL allocations replayed exactly once
+    assert not os.path.exists(d / "wal-3.jsonl")
+    cm.close()
 
 
 def test_volume_rotation_on_full_chunks(tmp_path, rng):
