@@ -1,0 +1,5 @@
+"""cfs-cli — operator CLI against the master admin API (cli/ analog)."""
+
+from chubaofs_tpu.cli.main import main
+
+__all__ = ["main"]

@@ -1,0 +1,5 @@
+"""Client layer — the FUSE-facing mount (client/ analog)."""
+
+from chubaofs_tpu.client.mount import Mount, MountError
+
+__all__ = ["Mount", "MountError"]

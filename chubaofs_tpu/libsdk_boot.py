@@ -1,0 +1,33 @@
+"""Bootstrap the embedded-interpreter side of libcfs.so.
+
+Reference counterpart: libsdk/libsdk.go's newClient — parse the config,
+build the SDK stack for one volume, hand back the handle the C ABI
+dispatches on. The C++ shim (native/libsdk/libcfs.cc) imports exactly this
+module and calls `new_mount(config_json)`.
+"""
+
+from __future__ import annotations
+
+import json
+
+from chubaofs_tpu.client.mount import Mount
+from chubaofs_tpu.sdk.cluster import RemoteCluster
+
+
+def new_mount(config_json: str) -> Mount:
+    cfg = json.loads(config_json)
+    masters = cfg.get("masterAddr") or cfg.get("masterAddrs")
+    if isinstance(masters, str):
+        masters = [masters]
+    if not masters:
+        raise ValueError("config needs masterAddr")
+    vol = cfg.get("volName")
+    if not vol:
+        raise ValueError("config needs volName")
+    access = cfg.get("accessAddr") or cfg.get("accessAddrs")
+    if isinstance(access, str):
+        access = [access]
+    cluster = RemoteCluster(masters, access_addrs=access)
+    fs = cluster.client(vol)
+    return Mount(fs, volume=vol, audit_dir=cfg.get("logDir"),
+                 client_id=cfg.get("clientId", ""))

@@ -268,6 +268,9 @@ class MasterClient:
     def data_partitions(self, name: str):
         return self.call(self._path("/client/partitions", name=name))
 
+    def create_data_partition(self, name: str):
+        return self.call(self._path("/admin/createDataPartition", name=name))
+
     def meta_partitions(self, name: str):
         return self.call(self._path("/client/metaPartitions", name=name))
 

@@ -158,10 +158,15 @@ class FsClient:
             ino, [{"loc": loc, "size": len(data)}], offset + len(data))
 
     def read_file(self, path: str, offset: int = 0, size: int | None = None) -> bytes:
+        return self.read_at(self.resolve(path), offset, size)
+
+    def read_at(self, ino: int, offset: int = 0, size: int | None = None) -> bytes:
+        """Positional read by inode — open files stay readable after their
+        path is unlinked (the client orphan-inode contract)."""
         try:
-            inode = self.meta.get_inode(self.resolve(path))
+            inode = self.meta.get_inode(ino)
         except OpError as e:
-            raise FsError(e.code, path) from None
+            raise FsError(e.code, f"ino {ino}") from None
         if size is None:
             size = inode.size - offset
         size = max(0, min(size, inode.size - offset))
@@ -200,7 +205,10 @@ class FsClient:
         self.bcache.put(key, whole)
         return whole[start:start + length]
 
-    def unlink(self, path: str) -> None:
+    def unlink(self, path: str, evict: bool = True) -> int:
+        """Remove the dentry + drop a link. evict=False keeps the inode alive
+        for holders of open handles (client orphan list); the caller must
+        evict_ino() on last close. Returns the inode id."""
         parent, name = self._resolve_parent(path)
         try:
             d = self.meta.lookup(parent, name)
@@ -210,7 +218,16 @@ class FsClient:
         except OpError as e:
             raise FsError(e.code, path) from None
         self.meta.unlink_inode(d.ino)
-        self.meta.evict_inode(d.ino)
+        if evict:
+            self.meta.evict_inode(d.ino)
+        return d.ino
+
+    def evict_ino(self, ino: int) -> None:
+        """Release an orphaned inode once its last open handle closes."""
+        try:
+            self.meta.evict_inode(ino)
+        except OpError as e:
+            raise FsError(e.code, f"ino {ino}") from None
 
     def rename(self, src: str, dst: str) -> None:
         sp, sn = self._resolve_parent(src)

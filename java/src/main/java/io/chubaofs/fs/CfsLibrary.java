@@ -1,0 +1,68 @@
+package io.chubaofs.fs;
+
+import com.sun.jna.Library;
+import com.sun.jna.Native;
+import com.sun.jna.Structure;
+
+import java.util.Arrays;
+import java.util.List;
+
+/**
+ * JNA binding of libcfs.so — the cfs_* C ABI.
+ *
+ * Reference counterpart: java/src/main/java/io/cubefs/fs/CfsLibrary.java
+ * (JNA over the cgo-built libcfs.so). The ABI is defined by
+ * native/libsdk/libcfs.h; this interface mirrors it one-to-one.
+ */
+public interface CfsLibrary extends Library {
+    CfsLibrary INSTANCE = Native.load("cfs", CfsLibrary.class);
+
+    @Structure.FieldOrder({"ino", "mode", "nlink", "size", "uid", "gid", "mtime", "isDir"})
+    class StatInfo extends Structure {
+        public long ino;
+        public int mode;
+        public int nlink;
+        public long size;
+        public int uid;
+        public int gid;
+        public double mtime;
+        public int isDir;
+
+        @Override
+        protected List<String> getFieldOrder() {
+            return Arrays.asList("ino", "mode", "nlink", "size", "uid", "gid", "mtime", "isDir");
+        }
+    }
+
+    long cfs_new_client(String configJson);
+
+    void cfs_close_client(long cid);
+
+    String cfs_last_error();
+
+    int cfs_open(long cid, String path, int flags, int mode);
+
+    int cfs_close(long cid, int fd);
+
+    long cfs_read(long cid, int fd, byte[] buf, long size, long offset);
+
+    long cfs_write(long cid, int fd, byte[] buf, long size, long offset);
+
+    int cfs_flush(long cid, int fd);
+
+    int cfs_fstat(long cid, int fd, StatInfo st);
+
+    int cfs_getattr(long cid, String path, StatInfo st);
+
+    int cfs_mkdirs(long cid, String path, int mode);
+
+    int cfs_rmdir(long cid, String path);
+
+    int cfs_unlink(long cid, String path);
+
+    int cfs_rename(long cid, String from, String to);
+
+    int cfs_truncate(long cid, String path, long size);
+
+    int cfs_readdir(long cid, String path, byte[] buf, int buflen);
+}

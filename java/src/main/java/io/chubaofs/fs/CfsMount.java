@@ -1,0 +1,112 @@
+package io.chubaofs.fs;
+
+import java.io.IOException;
+import java.nio.charset.StandardCharsets;
+
+/**
+ * High-level mount handle over one volume.
+ *
+ * Reference counterpart: java/src/main/java/io/cubefs/fs/CfsMount.java —
+ * the object-oriented face over the flat cfs_* ABI. Typical use:
+ *
+ * <pre>
+ *   CfsMount mnt = new CfsMount(
+ *       "{\"masterAddr\":\"10.0.0.1:17010\",\"volName\":\"vol\"}");
+ *   int fd = mnt.open("/a.txt", CfsMount.O_CREAT | CfsMount.O_RDWR, 0644);
+ *   mnt.write(fd, "hello".getBytes(), 0);
+ *   mnt.close(fd);
+ *   mnt.closeClient();
+ * </pre>
+ */
+public class CfsMount {
+    public static final int O_RDONLY = 0;
+    public static final int O_WRONLY = 1;
+    public static final int O_RDWR = 2;
+    public static final int O_CREAT = 0100;   // octal, matches the Mount flags
+    public static final int O_TRUNC = 01000;
+    public static final int O_APPEND = 02000;
+
+    private final CfsLibrary lib = CfsLibrary.INSTANCE;
+    private final long cid;
+
+    public CfsMount(String configJson) throws IOException {
+        cid = lib.cfs_new_client(configJson);
+        if (cid <= 0) {
+            throw new IOException("cfs_new_client: " + lib.cfs_last_error());
+        }
+    }
+
+    private int check(int rc, String op) throws IOException {
+        if (rc < 0) {
+            throw new IOException(op + ": errno " + (-rc) + " (" + lib.cfs_last_error() + ")");
+        }
+        return rc;
+    }
+
+    public int open(String path, int flags, int mode) throws IOException {
+        return check(lib.cfs_open(cid, path, flags, mode), "open " + path);
+    }
+
+    public void close(int fd) throws IOException {
+        check(lib.cfs_close(cid, fd), "close fd " + fd);
+    }
+
+    public long read(int fd, byte[] buf, long offset) throws IOException {
+        long n = lib.cfs_read(cid, fd, buf, buf.length, offset);
+        if (n < 0) {
+            throw new IOException("read: errno " + (-n) + " (" + lib.cfs_last_error() + ")");
+        }
+        return n;
+    }
+
+    public long write(int fd, byte[] buf, long offset) throws IOException {
+        long n = lib.cfs_write(cid, fd, buf, buf.length, offset);
+        if (n < 0) {
+            throw new IOException("write: errno " + (-n) + " (" + lib.cfs_last_error() + ")");
+        }
+        return n;
+    }
+
+    public void flush(int fd) throws IOException {
+        check(lib.cfs_flush(cid, fd), "flush");
+    }
+
+    public CfsLibrary.StatInfo getattr(String path) throws IOException {
+        CfsLibrary.StatInfo st = new CfsLibrary.StatInfo();
+        check(lib.cfs_getattr(cid, path, st), "getattr " + path);
+        return st;
+    }
+
+    public void mkdirs(String path, int mode) throws IOException {
+        check(lib.cfs_mkdirs(cid, path, mode), "mkdirs " + path);
+    }
+
+    public void rmdir(String path) throws IOException {
+        check(lib.cfs_rmdir(cid, path), "rmdir " + path);
+    }
+
+    public void unlink(String path) throws IOException {
+        check(lib.cfs_unlink(cid, path), "unlink " + path);
+    }
+
+    public void rename(String from, String to) throws IOException {
+        check(lib.cfs_rename(cid, from, to), "rename " + from);
+    }
+
+    public void truncate(String path, long size) throws IOException {
+        check(lib.cfs_truncate(cid, path, size), "truncate " + path);
+    }
+
+    public String[] readdir(String path) throws IOException {
+        byte[] buf = new byte[1 << 16];
+        int n = check(lib.cfs_readdir(cid, path, buf, buf.length), "readdir " + path);
+        if (n == 0) {
+            return new String[0];
+        }
+        return new String(buf, 0, n, StandardCharsets.UTF_8).split("\n");
+    }
+
+    public void closeClient() {
+        lib.cfs_close_client(cid);
+    }
+}

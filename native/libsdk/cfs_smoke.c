@@ -1,0 +1,66 @@
+/* cfs_smoke — pure-C end-to-end exercise of libcfs.so (no Python in this
+ * translation unit; the library embeds the interpreter itself).
+ *
+ * Usage: cfs_smoke '<config_json>'
+ * Exits 0 when the full open/write/read/readdir/rename/unlink cycle checks
+ * out; prints the failing step otherwise. The java/ JNA wrapper drives the
+ * same ABI, so this doubles as its conformance test. */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "libcfs.h"
+
+#define CHECK(cond, step)                                                  \
+  do {                                                                     \
+    if (!(cond)) {                                                         \
+      fprintf(stderr, "FAIL %s: %s\n", step, cfs_last_error());            \
+      return 1;                                                            \
+    }                                                                      \
+  } while (0)
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s '<config_json>'\n", argv[0]);
+    return 2;
+  }
+  int64_t cid = cfs_new_client(argv[1]);
+  CHECK(cid > 0, "new_client");
+
+  CHECK(cfs_mkdirs(cid, "/smoke/dir", 0755) == 0, "mkdirs");
+
+  /* O_CREAT|O_RDWR per the Mount flag set (0o102) */
+  int fd = cfs_open(cid, "/smoke/dir/file.bin", 0102, 0644);
+  CHECK(fd > 0, "open");
+
+  const char* msg = "written through the C ABI";
+  int64_t n = cfs_write(cid, fd, msg, strlen(msg), 0);
+  CHECK(n == (int64_t)strlen(msg), "write");
+  CHECK(cfs_flush(cid, fd) == 0, "flush");
+
+  char buf[256] = {0};
+  n = cfs_read(cid, fd, buf, sizeof buf, 0);
+  CHECK(n == (int64_t)strlen(msg) && memcmp(buf, msg, n) == 0, "read-back");
+
+  cfs_stat_t st;
+  CHECK(cfs_fstat(cid, fd, &st) == 0 && st.size == strlen(msg), "fstat");
+  CHECK(cfs_close(cid, fd) == 0, "close");
+
+  CHECK(cfs_getattr(cid, "/smoke/dir/file.bin", &st) == 0 && !st.is_dir,
+        "getattr");
+
+  char names[512];
+  CHECK(cfs_readdir(cid, "/smoke/dir", names, sizeof names) > 0, "readdir");
+  CHECK(strcmp(names, "file.bin") == 0, "readdir-content");
+
+  CHECK(cfs_rename(cid, "/smoke/dir/file.bin", "/smoke/dir/renamed.bin") == 0,
+        "rename");
+  CHECK(cfs_getattr(cid, "/smoke/dir/file.bin", &st) == -2 /* -ENOENT */,
+        "rename-old-gone");
+  CHECK(cfs_unlink(cid, "/smoke/dir/renamed.bin") == 0, "unlink");
+  CHECK(cfs_rmdir(cid, "/smoke/dir") == 0, "rmdir");
+
+  cfs_close_client(cid);
+  printf("libcfs smoke ok\n");
+  return 0;
+}

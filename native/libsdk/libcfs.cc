@@ -1,0 +1,333 @@
+// libcfs.so — the cfs_* C ABI over the embedded chubaofs_tpu client SDK.
+//
+// Reference counterpart: libsdk/libsdk.go (cgo c-shared build of the Go SDK;
+// //export cfs_* functions dispatching into sdk/meta + sdk/data through a
+// client registry keyed by int64 ids). Same shape here: an embedded CPython
+// runtime hosts chubaofs_tpu.client.Mount; each cfs_new_client builds a
+// RemoteCluster client for one volume; every call marshals through the C ABI
+// with errno-style returns. GIL discipline: every entry point takes
+// PyGILState_Ensure, so the library is safe from any C/Java thread, and
+// embedding inside an existing CPython process (e.g. ctypes) just reuses the
+// running interpreter.
+
+#include "libcfs.h"
+
+#include <Python.h>
+
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+std::mutex g_mu;
+std::map<int64_t, PyObject*> g_clients;  // cid -> Mount instance
+int64_t g_next_cid = 1;
+bool g_we_initialized = false;
+thread_local std::string g_err;
+
+// errno map for the Mount's FsError codes (libsdk returns -errno like the
+// reference's statusEIO/statusENOENT table, libsdk/libsdk.go)
+int code_to_errno(const std::string& code) {
+  if (code == "ENOENT") return 2;
+  if (code == "EIO" || code == "ECONN") return 5;
+  if (code == "EBADF") return 9;
+  if (code == "EEXIST") return 17;
+  if (code == "ENOTDIR") return 20;
+  if (code == "EISDIR") return 21;
+  if (code == "EINVAL") return 22;
+  if (code == "ENOTEMPTY") return 39;
+  if (code == "ENODATA") return 61;
+  return 5;  // EIO
+}
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+void ensure_python() {
+  std::lock_guard<std::mutex> g(g_mu);
+  if (Py_IsInitialized()) return;
+  Py_InitializeEx(0);
+  g_we_initialized = true;
+  // the embedded interpreter must find the package: honor CFS_PYTHONPATH
+  const char* extra = getenv("CFS_PYTHONPATH");
+  if (extra) {
+    PyObject* sys_path = PySys_GetObject("path");
+    PyObject* p = PyUnicode_FromString(extra);
+    PyList_Insert(sys_path, 0, p);
+    Py_DECREF(p);
+  }
+  PyEval_SaveThread();  // release the GIL; entry points re-take it
+}
+
+// capture the pending Python exception into g_err and return its -errno
+int capture_error() {
+  PyObject *type, *value, *tb;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  int err = 5;
+  g_err = "unknown error";
+  if (value) {
+    PyObject* code = PyObject_GetAttrString(value, "code");
+    if (code && PyUnicode_Check(code)) {
+      err = code_to_errno(PyUnicode_AsUTF8(code));
+    }
+    Py_XDECREF(code);
+    PyErr_Clear();
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      g_err = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  PyErr_Clear();
+  return -err;
+}
+
+PyObject* client(int64_t cid) {
+  std::lock_guard<std::mutex> g(g_mu);
+  auto it = g_clients.find(cid);
+  return it == g_clients.end() ? nullptr : it->second;
+}
+
+// call mount.<method>(*args); returns new ref or null (error captured)
+PyObject* call(int64_t cid, const char* method, PyObject* args) {
+  PyObject* mount = client(cid);
+  if (!mount) {
+    g_err = "bad client id";
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* fn = PyObject_GetAttrString(mount, method);
+  if (!fn) {
+    Py_XDECREF(args);
+    capture_error();
+    return nullptr;
+  }
+  PyObject* out = PyObject_CallObject(fn, args);
+  Py_DECREF(fn);
+  Py_XDECREF(args);
+  return out;
+}
+
+int fill_stat(PyObject* d, cfs_stat_t* st) {
+  if (!d || !PyDict_Check(d)) return -5;
+  auto geti = [&](const char* k) -> uint64_t {
+    PyObject* v = PyDict_GetItemString(d, k);
+    return v ? (uint64_t)PyLong_AsUnsignedLongLong(v) : 0;
+  };
+  st->ino = geti("ino");
+  st->mode = (uint32_t)geti("mode");
+  st->nlink = (uint32_t)geti("nlink");
+  st->size = geti("size");
+  st->uid = (uint32_t)geti("uid");
+  st->gid = (uint32_t)geti("gid");
+  PyObject* mt = PyDict_GetItemString(d, "mtime");
+  st->mtime = mt ? PyFloat_AsDouble(mt) : 0.0;
+  PyObject* isd = PyDict_GetItemString(d, "is_dir");
+  st->is_dir = isd && PyObject_IsTrue(isd) ? 1 : 0;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* cfs_last_error(void) { return g_err.c_str(); }
+
+int64_t cfs_new_client(const char* config_json) {
+  ensure_python();
+  Gil gil;
+  // build: cluster = RemoteCluster(masters, access); Mount(cluster.client(vol))
+  PyObject* boot = PyImport_ImportModule("chubaofs_tpu.libsdk_boot");
+  if (!boot) return capture_error();
+  PyObject* mount = PyObject_CallMethod(boot, "new_mount", "s", config_json);
+  Py_DECREF(boot);
+  if (!mount) return capture_error();
+  std::lock_guard<std::mutex> g(g_mu);
+  int64_t cid = g_next_cid++;
+  g_clients[cid] = mount;
+  return cid;
+}
+
+void cfs_close_client(int64_t cid) {
+  Gil gil;
+  PyObject* mount = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_mu);
+    auto it = g_clients.find(cid);
+    if (it == g_clients.end()) return;
+    mount = it->second;
+    g_clients.erase(it);
+  }
+  PyObject* r = PyObject_CallMethod(mount, "umount", nullptr);
+  Py_XDECREF(r);
+  PyErr_Clear();
+  Py_DECREF(mount);
+}
+
+int cfs_open(int64_t cid, const char* path, int flags, int mode) {
+  Gil gil;
+  PyObject* out = call(cid, "open", Py_BuildValue("(sii)", path, flags, mode));
+  if (!out) return capture_error();
+  int fd = (int)PyLong_AsLong(out);
+  Py_DECREF(out);
+  return fd;
+}
+
+int cfs_close(int64_t cid, int fd) {
+  Gil gil;
+  PyObject* out = call(cid, "close", Py_BuildValue("(i)", fd));
+  if (!out) return capture_error();
+  Py_DECREF(out);
+  return 0;
+}
+
+int64_t cfs_read(int64_t cid, int fd, char* buf, size_t size, int64_t offset) {
+  Gil gil;
+  PyObject* args = offset < 0 ? Py_BuildValue("(in)", fd, (Py_ssize_t)size)
+                              : Py_BuildValue("(inL)", fd, (Py_ssize_t)size,
+                                              (long long)offset);
+  PyObject* out = call(cid, "read", args);
+  if (!out) return capture_error();
+  char* data;
+  Py_ssize_t n;
+  if (PyBytes_AsStringAndSize(out, &data, &n) != 0) {
+    Py_DECREF(out);
+    return capture_error();
+  }
+  if ((size_t)n > size) n = (Py_ssize_t)size;
+  memcpy(buf, data, n);
+  Py_DECREF(out);
+  return n;
+}
+
+int64_t cfs_write(int64_t cid, int fd, const char* buf, size_t size,
+                  int64_t offset) {
+  Gil gil;
+  PyObject* payload = PyBytes_FromStringAndSize(buf, (Py_ssize_t)size);
+  PyObject* args =
+      offset < 0 ? Py_BuildValue("(iN)", fd, payload)
+                 : Py_BuildValue("(iNL)", fd, payload, (long long)offset);
+  PyObject* out = call(cid, "write", args);
+  if (!out) return capture_error();
+  long long n = PyLong_AsLongLong(out);
+  Py_DECREF(out);
+  return n;
+}
+
+int cfs_flush(int64_t cid, int fd) {
+  Gil gil;
+  PyObject* out = call(cid, "fsync", Py_BuildValue("(i)", fd));
+  if (!out) return capture_error();
+  Py_DECREF(out);
+  return 0;
+}
+
+int cfs_fstat(int64_t cid, int fd, cfs_stat_t* st) {
+  Gil gil;
+  PyObject* out = call(cid, "fstat", Py_BuildValue("(i)", fd));
+  if (!out) return capture_error();
+  int rc = fill_stat(out, st);
+  Py_DECREF(out);
+  return rc;
+}
+
+int cfs_getattr(int64_t cid, const char* path, cfs_stat_t* st) {
+  Gil gil;
+  PyObject* out = call(cid, "stat", Py_BuildValue("(s)", path));
+  if (!out) return capture_error();
+  int rc = fill_stat(out, st);
+  Py_DECREF(out);
+  return rc;
+}
+
+int cfs_mkdirs(int64_t cid, const char* path, int mode) {
+  Gil gil;
+  PyObject* mount = client(cid);
+  if (!mount) {
+    g_err = "bad client id";
+    return -9;
+  }
+  // Mount.mkdir is single-level; mkdirs lives on the underlying FsClient
+  PyObject* fs = PyObject_GetAttrString(mount, "fs");
+  if (!fs) return capture_error();
+  PyObject* out = PyObject_CallMethod(fs, "mkdirs", "si", path, mode);
+  Py_DECREF(fs);
+  if (!out) return capture_error();
+  Py_DECREF(out);
+  return 0;
+}
+
+int cfs_rmdir(int64_t cid, const char* path) {
+  Gil gil;
+  PyObject* out = call(cid, "rmdir", Py_BuildValue("(s)", path));
+  if (!out) return capture_error();
+  Py_DECREF(out);
+  return 0;
+}
+
+int cfs_unlink(int64_t cid, const char* path) {
+  Gil gil;
+  PyObject* out = call(cid, "unlink", Py_BuildValue("(s)", path));
+  if (!out) return capture_error();
+  Py_DECREF(out);
+  return 0;
+}
+
+int cfs_rename(int64_t cid, const char* from, const char* to) {
+  Gil gil;
+  PyObject* out = call(cid, "rename", Py_BuildValue("(ss)", from, to));
+  if (!out) return capture_error();
+  Py_DECREF(out);
+  return 0;
+}
+
+int cfs_truncate(int64_t cid, const char* path, int64_t size) {
+  Gil gil;
+  PyObject* out = call(cid, "truncate", Py_BuildValue("(sL)", path,
+                                                      (long long)size));
+  if (!out) return capture_error();
+  Py_DECREF(out);
+  return 0;
+}
+
+int cfs_readdir(int64_t cid, const char* path, char* buf, int buflen) {
+  Gil gil;
+  PyObject* out = call(cid, "readdir", Py_BuildValue("(s)", path));
+  if (!out) return capture_error();
+  std::string joined;
+  if (PyList_Check(out)) {
+    for (Py_ssize_t i = 0; i < PyList_Size(out); i++) {
+      PyObject* item = PyList_GetItem(out, i);
+      const char* s = PyUnicode_AsUTF8(item);
+      if (s) {
+        if (!joined.empty()) joined += "\n";
+        joined += s;
+      }
+    }
+  }
+  Py_DECREF(out);
+  if (!buf || buflen <= 0) {
+    g_err = "readdir: bad buffer";
+    return -22;  // -EINVAL
+  }
+  int n = (int)joined.size();
+  if (n >= buflen) {
+    // truncate on an entry boundary, never mid-filename
+    n = buflen - 1;
+    while (n > 0 && joined[n] != '\n') n--;
+  }
+  memcpy(buf, joined.data(), n);
+  buf[n] = 0;
+  return n;
+}
+
+}  // extern "C"

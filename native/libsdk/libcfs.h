@@ -1,0 +1,64 @@
+/* libcfs — C ABI for the chubaofs-tpu client SDK.
+ *
+ * Reference counterpart: libsdk/libsdk.go:259-… (cfs_new_client, cfs_open,
+ * cfs_read, cfs_write, … exported via cgo `c-shared` as libcfs.so) and the
+ * C structs in its cgo preamble (libsdk/libsdk.go:1-40). The reference
+ * compiles its host-language SDK (Go) into the shared library; this build
+ * does the same with its host-language SDK (Python) embedded behind the
+ * identical surface — callers (C, Java/JNA, Python-free processes) see only
+ * this header.
+ *
+ * Conventions (matching the reference):
+ *   - a client id (int64) names one mounted volume;
+ *   - fds are per-client small ints;
+ *   - errors return negative errno-style codes (-ENOENT, -EIO, ...).
+ */
+#ifndef CFS_LIBSDK_H
+#define CFS_LIBSDK_H
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef struct {
+  uint64_t ino;
+  uint32_t mode;
+  uint32_t nlink;
+  uint64_t size;
+  uint32_t uid;
+  uint32_t gid;
+  double mtime;
+  int is_dir;
+} cfs_stat_t;
+
+/* config_json: {"masterAddr": "host:port" | ["h:p",...], "volName": "...",
+ *               "accessAddr": "h:p" (cold volumes), "logDir": "..." } */
+int64_t cfs_new_client(const char* config_json);
+void cfs_close_client(int64_t cid);
+/* last error message for this thread (valid until the next call) */
+const char* cfs_last_error(void);
+
+int cfs_open(int64_t cid, const char* path, int flags, int mode);
+int cfs_close(int64_t cid, int fd);
+int64_t cfs_read(int64_t cid, int fd, char* buf, size_t size, int64_t offset);
+int64_t cfs_write(int64_t cid, int fd, const char* buf, size_t size,
+                  int64_t offset);
+int cfs_flush(int64_t cid, int fd);
+int cfs_fstat(int64_t cid, int fd, cfs_stat_t* st);
+
+int cfs_getattr(int64_t cid, const char* path, cfs_stat_t* st);
+int cfs_mkdirs(int64_t cid, const char* path, int mode);
+int cfs_rmdir(int64_t cid, const char* path);
+int cfs_unlink(int64_t cid, const char* path);
+int cfs_rename(int64_t cid, const char* from, const char* to);
+int cfs_truncate(int64_t cid, const char* path, int64_t size);
+/* entries newline-joined into buf; returns bytes written or -errno */
+int cfs_readdir(int64_t cid, const char* path, char* buf, int buflen);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* CFS_LIBSDK_H */

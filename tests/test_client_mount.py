@@ -1,0 +1,135 @@
+"""Client Mount layer: fd table, caches, orphan list, audit (client/ analog)."""
+
+import os
+
+import pytest
+
+from chubaofs_tpu.client.mount import (
+    Mount,
+    MountError,
+    O_APPEND,
+    O_CREAT,
+    O_RDONLY,
+    O_RDWR,
+    O_TRUNC,
+    O_WRONLY,
+)
+from chubaofs_tpu.deploy import FsCluster
+from chubaofs_tpu.sdk.fs import FsError
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = FsCluster(str(tmp_path_factory.mktemp("mnt")), n_nodes=3, blob_nodes=6,
+                  data_nodes=4)
+    c.create_volume("mv", cold=False)
+    yield c
+    c.close()
+
+
+@pytest.fixture
+def mnt(cluster, tmp_path):
+    m = Mount(cluster.client("mv"), volume="mv", audit_dir=str(tmp_path / "audit"))
+    yield m
+    m.umount()
+
+
+def test_open_write_read_close(mnt):
+    fd = mnt.open("/f1.txt", O_CREAT | O_RDWR)
+    assert mnt.write(fd, b"hello ") == 6
+    assert mnt.write(fd, b"world") == 5
+    mnt.lseek(fd, 0)
+    assert mnt.read(fd, 100) == b"hello world"
+    assert mnt.fstat(fd)["size"] == 11
+    mnt.close(fd)
+    with pytest.raises(MountError):
+        mnt.read(fd, 1)  # EBADF after close
+
+
+def test_positional_io_and_append(mnt):
+    fd = mnt.open("/f2.bin", O_CREAT | O_WRONLY)
+    mnt.write(fd, b"A" * 100)
+    mnt.write(fd, b"B" * 10, offset=50)
+    mnt.close(fd)
+    fd = mnt.open("/f2.bin", O_WRONLY | O_APPEND)
+    mnt.write(fd, b"C" * 5)
+    mnt.close(fd)
+    fd = mnt.open("/f2.bin")
+    data = mnt.read(fd, 1000)
+    mnt.close(fd)
+    assert data == b"A" * 50 + b"B" * 10 + b"A" * 40 + b"C" * 5
+
+
+def test_o_trunc(mnt):
+    fd = mnt.open("/f3", O_CREAT | O_WRONLY)
+    mnt.write(fd, b"long old content")
+    mnt.close(fd)
+    fd = mnt.open("/f3", O_WRONLY | O_TRUNC)
+    mnt.write(fd, b"new")
+    mnt.close(fd)
+    fd = mnt.open("/f3")
+    assert mnt.read(fd, 100) == b"new"
+    mnt.close(fd)
+
+
+def test_orphan_unlink_while_open(mnt):
+    """POSIX: an unlinked file stays readable through open fds; the last
+    close evicts it (the client orphan inode list)."""
+    fd = mnt.open("/doomed", O_CREAT | O_RDWR)
+    mnt.write(fd, b"still here")
+    mnt.unlink("/doomed")
+    with pytest.raises(FsError):
+        mnt.stat("/doomed")  # gone from the namespace
+    mnt.lseek(fd, 0)
+    assert mnt.read(fd, 100) == b"still here"  # data alive via the fd
+    assert mnt.statfs()["orphans"] == 1
+    mnt.close(fd)
+    assert mnt.statfs()["orphans"] == 0
+
+
+def test_namespace_ops_and_caches(mnt):
+    mnt.mkdir("/dir")
+    fd = mnt.open("/dir/a", O_CREAT | O_WRONLY)
+    mnt.write(fd, b"x")
+    mnt.close(fd)
+    assert mnt.readdir("/dir") == ["a"]
+    st = mnt.stat("/dir/a")
+    assert st["size"] == 1
+    mnt.rename("/dir/a", "/dir/b")
+    assert mnt.readdir("/dir") == ["b"]
+    with pytest.raises(FsError):
+        mnt.stat("/dir/a")  # lookup cache must not serve the old name
+    mnt.truncate("/dir/b", 0)
+    assert mnt.stat("/dir/b")["size"] == 0
+    mnt.unlink("/dir/b")
+    mnt.rmdir("/dir")
+    with pytest.raises(FsError):
+        mnt.readdir("/dir")
+
+
+def test_readonly_fd_rejects_write(mnt):
+    fd = mnt.open("/ro", O_CREAT | O_WRONLY)
+    mnt.write(fd, b"data")
+    mnt.close(fd)
+    fd = mnt.open("/ro", O_RDONLY)
+    with pytest.raises(MountError):
+        mnt.write(fd, b"nope")
+    mnt.close(fd)
+
+
+def test_audit_log_written(cluster, tmp_path):
+    audit_dir = str(tmp_path / "adt")
+    m = Mount(cluster.client("mv"), volume="mv", audit_dir=audit_dir)
+    fd = m.open("/audited", O_CREAT | O_WRONLY)
+    m.write(fd, b"z")
+    m.close(fd)
+    try:
+        m.stat("/nope")
+    except FsError:
+        pass
+    m.umount()
+    logs = [f for f in os.listdir(audit_dir) if f.startswith("audit")]
+    assert logs
+    body = open(os.path.join(audit_dir, logs[0])).read()
+    assert ",open,/audited," in body and ",write,/audited," in body
+    assert ",stat,/nope,ENOENT" in body  # errors carry their code

@@ -248,3 +248,62 @@ def test_daemon_cold_volume_and_s3(cluster, tmp_path):
         if onode is not None:
             onode.stop()
         bs.stop()
+
+
+# -- CLI (cfs-cli analog) ------------------------------------------------------
+
+
+def test_cli_against_daemon_cluster(cluster, capsys):
+    import io
+    import json as _json
+
+    from chubaofs_tpu.cli.main import main as cli_main
+
+    addr = cluster["master"].addr
+
+    def run(*argv, expect=0):
+        buf = io.StringIO()
+        rc = cli_main(["--addr", addr, *argv], out=buf)
+        assert rc == expect, buf.getvalue()
+        return buf.getvalue()
+
+    out = run("cluster", "info")
+    assert "Leader" in out and "meta" in out
+
+    run("vol", "create", "clivol", "--dp-count", "3")
+    out = run("vol", "list")
+    assert "clivol" in out
+    out = run("--json", "vol", "info", "clivol")
+    v = _json.loads(out)
+    assert v["name"] == "clivol" and len(v["meta_partitions"]) >= 1
+
+    out = run("metanode", "list")
+    assert out.count("\n") >= 4  # header + 3 metanodes
+    out = run("datanode", "list")
+    assert out.count("\n") >= 4
+    out = run("metapartition", "list", "clivol")
+    assert "PARTITION_ID" in out or "partition_id" in out
+    out = run("datapartition", "list", "clivol")
+    assert "PID" in out
+
+    out = run("--json", "user", "create", "cliuser")
+    u = _json.loads(out)
+    assert len(u["access_key"]) == 16
+    out = run("user", "perm", "cliuser", "clivol", "writable")
+    out = run("--json", "user", "info", "cliuser")
+    assert _json.loads(out)["authorized_vols"]["clivol"] == ["perm:writable"]
+    out = run("user", "list")
+    assert "cliuser" in out
+    run("user", "delete", "cliuser")
+
+    # delete without --yes refuses; with --yes succeeds
+    import pytest as _pytest
+
+    with _pytest.raises(SystemExit):
+        run("vol", "delete", "clivol")
+    run("vol", "delete", "clivol", "--yes")
+    out = run("vol", "list")
+    assert "clivol" not in out
+
+    out = run("completion")
+    assert "complete -F _cfs_cli" in out

@@ -1,0 +1,102 @@
+"""libcfs C ABI: build the native library, spin a real daemon cluster in
+subprocesses, and run the pure-C smoke driver against it (libsdk/ analog —
+the reference exercises libcfs.so from C/Java the same way)."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIBSDK = os.path.join(REPO, "native", "libsdk")
+
+
+def _build():
+    try:
+        subprocess.run(["make", "-C", LIBSDK, "build/cfs_smoke"],
+                       check=True, capture_output=True, timeout=180)
+    except (OSError, subprocess.SubprocessError) as e:
+        pytest.skip(f"libcfs build unavailable: {e}")
+
+
+def _spawn(cfg: dict, tmp, name: str, env):
+    path = str(tmp / f"{name}.json")
+    with open(path, "w") as f:
+        json.dump(cfg, f)
+    return subprocess.Popen(
+        [sys.executable, "-m", "chubaofs_tpu.cmd", "-c", path],
+        stdout=open(str(tmp / f"{name}.log"), "w"),
+        stderr=subprocess.STDOUT, env=env)
+
+
+@pytest.mark.slow
+def test_c_smoke_against_subprocess_cluster(tmp_path):
+    if shutil.which("make") is None:
+        pytest.skip("no make")
+    _build()
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+
+    procs = []
+    try:
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            api_port = s.getsockname()[1]
+        master_addr = f"127.0.0.1:{api_port}"
+        procs.append(_spawn({
+            "role": "master", "id": 1,
+            "raftPeers": {"1": "127.0.0.1:0"},
+            "listen": master_addr, "walDir": str(tmp_path / "m1"),
+        }, tmp_path, "m1", env))
+        time.sleep(0.8)
+        for i in (2, 3, 4):
+            procs.append(_spawn({
+                "role": "metanode", "id": i, "masterAddrs": [master_addr],
+                "walDir": str(tmp_path / f"mn{i}"),
+            }, tmp_path, f"mn{i}", env))
+        for j in (1, 2, 3):
+            procs.append(_spawn({
+                "role": "datanode", "id": 100 + j, "masterAddrs": [master_addr],
+                "disks": [str(tmp_path / f"dn{j}" / "d0")],
+                "walDir": str(tmp_path / f"dn{j}" / "wal"),
+            }, tmp_path, f"dn{j}", env))
+
+        from chubaofs_tpu.master.api_service import MasterClient
+
+        mc = MasterClient([master_addr])
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                if sum(1 for n in mc.get_cluster()["nodes"] if n["addr"]) >= 6:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.3)
+        else:
+            raise AssertionError("cluster did not come up")
+        mc.create_volume("libvol", cold=False)
+
+        smoke_env = dict(env)
+        smoke_env["CFS_PYTHONPATH"] = REPO
+        cfg = json.dumps({"masterAddr": master_addr, "volName": "libvol"})
+        out = subprocess.run(
+            [os.path.join(LIBSDK, "build", "cfs_smoke"), cfg],
+            capture_output=True, timeout=120, env=smoke_env, text=True)
+        assert out.returncode == 0, f"stdout={out.stdout} stderr={out.stderr}"
+        assert "libcfs smoke ok" in out.stdout
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                p.kill()
