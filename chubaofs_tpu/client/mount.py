@@ -66,6 +66,8 @@ class Mount:
     # -- audit -----------------------------------------------------------------
 
     def _op(self, op: str, path: str, fn):
+        from chubaofs_tpu.meta.metanode import OpError
+
         t0 = time.perf_counter()
         err = ""
         try:
@@ -73,6 +75,11 @@ class Mount:
         except FsError as e:
             err = e.code
             raise
+        except OpError as e:
+            # direct meta calls (stat/truncate caches) surface the same
+            # FsError contract the fs verbs do
+            err = e.code
+            raise MountError(e.code, path) from None
         finally:
             if self.audit:
                 us = int((time.perf_counter() - t0) * 1e6)
@@ -91,11 +98,16 @@ class Mount:
         return ino
 
     def _stat_ino(self, ino: int) -> dict:
+        from chubaofs_tpu.meta.metanode import OpError
+
         now = time.time()
         hit = self._attr.get(ino)
         if hit and now < hit[0]:
             return hit[1]
-        inode = self.fs.meta.get_inode(ino)
+        try:
+            inode = self.fs.meta.get_inode(ino)
+        except OpError as e:
+            raise MountError(e.code, f"ino {ino}") from None
         st = {"ino": inode.ino, "mode": inode.mode, "size": inode.size,
               "nlink": inode.nlink, "uid": inode.uid, "gid": inode.gid,
               "mtime": inode.mtime, "is_dir": inode.is_dir}

@@ -262,8 +262,60 @@ class MetaNodeDaemon(_Daemon):
         self._every(HEARTBEAT_INTERVAL, self._heartbeat,
                     f"metanode{self.node_id}-hb")
         self._wire_purge(cfg)
+        self.metanode.tx_resolver_hook = self._resolve_tx
         self._every(5.0, self.metanode.drain_freelists,
                     f"metanode{self.node_id}-freelist")
+        self._every(5.0, self.metanode.sweep_transactions,
+                    f"metanode{self.node_id}-txsweep")
+        self._every(5.0, self._push_quota_flags,
+                    f"metanode{self.node_id}-quota")
+
+    def _remote_metanodes(self):
+        from chubaofs_tpu.meta.service import RemoteMetaNode
+
+        handles = {}
+        for n in self.mc.get_cluster()["nodes"]:
+            if n["kind"] == "meta" and n["addr"]:
+                handles[n["node_id"]] = RemoteMetaNode(n["addr"])
+        return handles
+
+    def _resolve_tx(self, tm_pid: int, tx_id: str) -> str:
+        """Participant-sweep hook over the wire: find the TM partition's
+        peers in the master view, ask each for the decision."""
+        from chubaofs_tpu.meta.metanode import OpError
+        from chubaofs_tpu.raft.server import NotLeaderError
+
+        handles = self._remote_metanodes()
+        for v in self.mc.list_volumes():
+            for mp in self.mc.meta_partitions(v["name"]):
+                if mp["partition_id"] != tm_pid:
+                    continue
+                for peer in mp["peers"]:
+                    h = handles.get(peer)
+                    if h is None:
+                        continue
+                    try:
+                        return h.tx_status(tm_pid, tx_id)
+                    except (NotLeaderError, OpError):
+                        continue
+                raise RuntimeError(f"tm partition {tm_pid}: no leader reachable")
+        return "unknown"  # partition no longer exists: nothing can commit it
+
+    def _push_quota_flags(self):
+        """One quota aggregation round per volume; only the node leading the
+        volume's FIRST partition pushes, so the cluster does it once."""
+        from chubaofs_tpu.sdk.cluster import _MasterAdapter
+        from chubaofs_tpu.sdk.meta_wrapper import MetaWrapper
+
+        adapter = _MasterAdapter(self.mc)
+        handles = None
+        for v in self.mc.list_volumes():
+            mps = self.mc.meta_partitions(v["name"])
+            if not mps or not self.metanode.is_leader(mps[0]["partition_id"]):
+                continue
+            if handles is None:
+                handles = self._remote_metanodes()
+            MetaWrapper(adapter, handles, v["name"]).push_quota_flags()
 
     def _register(self):
         self.mc.add_node(self.node_id, "meta", self.addr,

@@ -114,6 +114,7 @@ class FsCluster:
         for mn in self.metanodes.values():
             mn.data_purge_hook = self._purge_inode_data
             mn.extent_purge_hook = self._purge_extent_entry
+            mn.tx_resolver_hook = self._resolve_tx
 
     # -- pumping -----------------------------------------------------------------
 
@@ -131,6 +132,12 @@ class FsCluster:
         ))
         for mn in self.metanodes.values():
             mn.drain_freelists()
+            mn.sweep_transactions()
+        for vol_name in self.volume_names():
+            try:
+                MetaWrapper(lead, self.metanodes, vol_name).push_quota_flags()
+            except Exception:
+                pass  # a mid-election partition: next tick retries
         self.blobstore.run_background_once()
 
     def repair_data_partitions(self) -> int:
@@ -159,6 +166,14 @@ class FsCluster:
             if self.rafts[i].is_leader(AUTH_GROUP):
                 return node
         raise MasterError("no authnode leader")
+
+    def _resolve_tx(self, tm_pid: int, tx_id: str) -> str:
+        """Participant-sweep hook: ask the TM partition's leader for the
+        txn decision (metanode tx RM->TM status query analog)."""
+        for mn in self.metanodes.values():
+            if tm_pid in mn.partitions and mn.raft.is_leader(tm_pid):
+                return mn.tx_status(tm_pid, tx_id)
+        raise RuntimeError(f"no leader for tm partition {tm_pid}")
 
     def _datanode_at(self, addr: str) -> DataNode | None:
         return next((d for d in self.datanodes.values() if d.addr == addr), None)

@@ -111,6 +111,58 @@ class MetaNode:
         except MetaError as e:
             raise OpError(e.code, str(e)) from None
 
+    def quota_usage(self, partition_id: int):
+        try:
+            return self._leader_sm(partition_id).quota_usage()
+        except MetaError as e:
+            raise OpError(e.code, str(e)) from None
+
+    def tx_status(self, partition_id: int, tx_id: str) -> str:
+        try:
+            return self._leader_sm(partition_id).tx_status(tx_id)
+        except MetaError as e:
+            raise OpError(e.code, str(e)) from None
+
+    # injected by the deployment: (tm_pid, tx_id) -> "committed" |
+    # "rolledback" | "prepared" | "unknown" — asks the TM partition's leader
+    tx_resolver_hook = None
+
+    def sweep_transactions(self) -> int:
+        """Resolve expired prepared 2PC txns (tx GC, metanode/transaction.go
+        timeouts). TM-anchored txns roll back in the sweep itself; participant
+        txns roll FORWARD or BACK to match the TM's recorded decision."""
+        import time
+
+        swept = 0
+        for pid in list(self.partitions):
+            if not self.raft.is_leader(pid):
+                continue
+            if not self.partitions[pid].txns:
+                continue
+            try:
+                unresolved = self.submit_sync(pid, "tx_sweep", now=time.time())
+            except (NotLeaderError, OpError):
+                continue
+            swept += len(unresolved)
+            for tx_id, tm_pid in unresolved:
+                decision = "unknown"
+                if self.tx_resolver_hook is not None:
+                    try:
+                        decision = self.tx_resolver_hook(tm_pid, tx_id)
+                    except Exception:
+                        continue  # TM unreachable: keep the txn for next sweep
+                try:
+                    if decision == "committed":
+                        self.submit_sync(pid, "tx_commit", tx_id=tx_id)
+                    elif decision in ("rolledback", "unknown"):
+                        # unknown = the TM never saw the txn (coordinator died
+                        # before preparing it there): nothing can commit it
+                        self.submit_sync(pid, "tx_rollback", tx_id=tx_id)
+                    # "prepared": TM undecided; its own sweep will decide first
+                except (NotLeaderError, OpError):
+                    continue
+        return swept
+
     # -- freelist delete loop (partition_free_list.go:180,233 analog) ----------
 
     def drain_freelists(self) -> int:

@@ -45,6 +45,14 @@ class NotDir(MetaError):
     code = "ENOTDIR"
 
 
+class TxConflict(MetaError):
+    code = "ETXCONFLICT"
+
+
+class QuotaExceeded(MetaError):
+    code = "EDQUOT"
+
+
 class OutOfRange(MetaError):
     code = "ERANGE"
 
@@ -113,7 +121,18 @@ class MetaPartitionSM(StateMachine):
         self.del_extents: list[tuple[int, dict]] = []
         self.del_seq = 0
         self.multipart: dict[str, dict] = {}  # S3 multipart sessions
-        self.uniq_seen: dict[int, int] = {}  # client_id -> last uniq id (idempotence)
+        # client-op idempotence (metanode/uniq_checker.go): per client a
+        # bounded window of uniq-id -> recorded result, replicated via raft so
+        # every replica answers a retried op identically
+        self.uniq_seen: dict[str, dict] = {}
+        # two-phase transactions (metanode/transaction.go): prepared txns hold
+        # intent locks until commit/rollback/expiry
+        self.txns: dict[str, dict] = {}  # tx_id -> {ops, deadline}
+        self.tx_locks: dict[tuple, str] = {}  # lock key -> tx_id
+        self.tx_done: dict[str, str] = {}  # tx_id -> "committed"|"rolledback"
+        # directory quotas (metanode quota + master_quota_manager):
+        # qid -> {max_files, max_bytes, files, bytes, exceeded}
+        self.quotas: dict[int, dict] = {}
         if start == ROOT_INO:
             root = Inode(ino=ROOT_INO, mode=stat_mod.S_IFDIR | 0o755, nlink=2)
             self.inodes[ROOT_INO] = root
@@ -121,14 +140,37 @@ class MetaPartitionSM(StateMachine):
 
     # -- raft StateMachine ---------------------------------------------------
 
+    UNIQ_WINDOW = 128
+
     def apply(self, data, index: int):
         op, args = data
+        uniq = args.get("_uniq")  # never mutate args: the tuple is shared
+        if uniq is not None:
+            cid, uid = uniq
+            hist = self.uniq_seen.get(cid)
+            if hist is not None and uid in hist:
+                return hist[uid]  # duplicate delivery: replay the answer
+            args = {k: v for k, v in args.items() if k != "_uniq"}
         try:
-            return ("ok", getattr(self, "_op_" + op)(**args))
+            result = ("ok", getattr(self, "_op_" + op)(**args))
         except MetaError as e:
             # errors are VALUES through consensus: every replica must take the
             # same path, and the proposer gets the errno back
-            return ("err", e.code, str(e))
+            result = ("err", e.code, str(e))
+        if uniq is not None:
+            hist = self.uniq_seen.setdefault(cid, {})
+            hist[uid] = result
+            if len(hist) > self.UNIQ_WINDOW:
+                for k in sorted(hist)[: len(hist) - self.UNIQ_WINDOW]:
+                    del hist[k]
+            # recency order: re-inserting on every use makes eviction drop the
+            # LEAST RECENTLY ACTIVE client, and dict order is identical on
+            # every replica (same apply sequence), so it stays deterministic
+            self.uniq_seen[cid] = self.uniq_seen.pop(cid)
+            if len(self.uniq_seen) > 512:
+                for k in list(self.uniq_seen)[:128]:
+                    del self.uniq_seen[k]
+        return result
 
     def snapshot(self) -> bytes:
         return pickle.dumps(
@@ -145,6 +187,10 @@ class MetaPartitionSM(StateMachine):
                 "del_seq": self.del_seq,
                 "multipart": self.multipart,
                 "uniq_seen": self.uniq_seen,
+                "txns": self.txns,
+                "tx_locks": self.tx_locks,
+                "tx_done": self.tx_done,
+                "quotas": self.quotas,
             }
         )
 
@@ -160,6 +206,10 @@ class MetaPartitionSM(StateMachine):
         self.del_seq = st.get("del_seq", 0)
         self.multipart = st["multipart"]
         self.uniq_seen = st["uniq_seen"]
+        self.txns = st.get("txns", {})
+        self.tx_locks = st.get("tx_locks", {})
+        self.tx_done = st.get("tx_done", {})
+        self.quotas = st.get("quotas", {})
         self.children = {}
         for d in self.dentries.values():
             self.children.setdefault(d.parent, {})[d.name] = d
@@ -172,13 +222,28 @@ class MetaPartitionSM(StateMachine):
         self.cursor += 1
         return self.cursor
 
-    def _op_create_inode(self, mode: int, uid: int = 0, gid: int = 0):
+    QUOTA_XATTR = "__quota_ids__"
+
+    def _op_create_inode(self, mode: int, uid: int = 0, gid: int = 0,
+                         quota_ids: list[int] | None = None):
         ino = self._next_ino()
         inode = Inode(ino=ino, mode=mode, uid=uid, gid=gid)
         if inode.is_dir:
             inode.nlink = 2
+        if quota_ids:  # subtree quota ids stick to the inode for byte charges
+            import json as _json
+
+            inode.xattrs[self.QUOTA_XATTR] = _json.dumps(quota_ids).encode()
         self.inodes[ino] = inode
         return inode
+
+    def _inode_quota_ids(self, inode: Inode) -> list[int]:
+        raw = inode.xattrs.get(self.QUOTA_XATTR)
+        if not raw:
+            return []
+        import json as _json
+
+        return _json.loads(raw)
 
     def _op_unlink_inode(self, ino: int):
         inode = self._get_inode(ino)
@@ -196,6 +261,9 @@ class MetaPartitionSM(StateMachine):
         if inode.nlink <= 0 or (inode.is_dir and inode.nlink <= 1):
             del self.inodes[ino]
             if not inode.is_dir:
+                if inode.size:  # the file's bytes leave the quota with it
+                    self._quota_charge_bytes(
+                        self._inode_quota_ids(inode), -inode.size)
                 self.freelist.append(ino)
                 self.orphans[ino] = inode
         return None
@@ -218,6 +286,9 @@ class MetaPartitionSM(StateMachine):
     def _op_append_extents(self, ino: int, extents: list[dict], size: int):
         """AppendExtentKey analog (sdk/meta/api.go:1137): extend the file map."""
         inode = self._get_inode(ino)
+        grow = max(0, size - inode.size)
+        if grow:
+            self._quota_charge_bytes(self._inode_quota_ids(inode), grow)
         for e in extents:
             inode.extents.append(ExtentKey(**e))
         inode.size = max(inode.size, size)
@@ -227,6 +298,9 @@ class MetaPartitionSM(StateMachine):
     def _op_append_obj_extents(self, ino: int, locations: list[dict], size: int):
         """Cold tier: record blobstore locations (ObjExtents, inode.go:73-74)."""
         inode = self._get_inode(ino)
+        grow = max(0, size - inode.size)
+        if grow:
+            self._quota_charge_bytes(self._inode_quota_ids(inode), grow)
         inode.obj_extents.extend(locations)
         inode.size = max(inode.size, size)
         inode.mtime = time.time()
@@ -234,6 +308,9 @@ class MetaPartitionSM(StateMachine):
 
     def _op_truncate(self, ino: int, size: int):
         inode = self._get_inode(ino)
+        shrink = max(0, inode.size - size)
+        if shrink:  # credit the quota back for the cut-off span
+            self._quota_charge_bytes(self._inode_quota_ids(inode), -shrink)
         dropped = [e for e in inode.extents if e.file_offset >= size]
         inode.extents = [e for e in inode.extents if e.file_offset < size]
         for e in inode.extents:
@@ -270,13 +347,24 @@ class MetaPartitionSM(StateMachine):
 
     # -- fsm ops: dentries ------------------------------------------------------
 
-    def _op_create_dentry(self, parent: int, name: str, ino: int, mode: int):
+    def _check_lock(self, key: tuple, tx_id: str | None = None):
+        """A prepared transaction's intent lock blocks outside mutations."""
+        holder = self.tx_locks.get(key)
+        if holder is not None and holder != tx_id:
+            raise TxConflict(f"{key} locked by txn {holder}")
+
+    def _op_create_dentry(self, parent: int, name: str, ino: int, mode: int,
+                          quota_ids: list[int] | None = None,
+                          _tx: str | None = None):
         key = (parent, name)
+        self._check_lock(("d", parent, name), _tx)
+        self._check_lock(("c", parent), _tx)  # dir-delete freezes the child set
         if key in self.dentries:
             raise Exists(f"{name!r} exists in {parent}")
         pdir = self._get_inode(parent)
         if not pdir.is_dir:
             raise NotDir(f"parent {parent}")
+        self._quota_charge_files(quota_ids, +1)
         d = Dentry(parent, name, ino, mode)
         self.dentries[key] = d
         self.children.setdefault(parent, {})[name] = d
@@ -285,13 +373,17 @@ class MetaPartitionSM(StateMachine):
         pdir.mtime = time.time()
         return d
 
-    def _op_delete_dentry(self, parent: int, name: str):
+    def _op_delete_dentry(self, parent: int, name: str,
+                          quota_ids: list[int] | None = None,
+                          _tx: str | None = None):
         key = (parent, name)
+        self._check_lock(("d", parent, name), _tx)
         d = self.dentries.get(key)
         if d is None:
             raise NoEntry(f"{name!r} in {parent}")
         if stat_mod.S_ISDIR(d.mode) and self.children.get(d.ino):
             raise NotEmpty(f"{name!r}")
+        self._quota_charge_files(quota_ids, -1)
         del self.dentries[key]
         self.children.get(parent, {}).pop(name, None)
         pdir = self.inodes.get(parent)
@@ -301,15 +393,21 @@ class MetaPartitionSM(StateMachine):
             pdir.mtime = time.time()
         return d
 
-    def _op_rename_local(self, src_parent: int, src_name: str, dst_parent: int, dst_name: str):
-        """Atomic rename when both dentries live in this partition."""
+    def _op_rename_local(self, src_parent: int, src_name: str, dst_parent: int,
+                         dst_name: str, src_quota_ids: list[int] | None = None,
+                         dst_quota_ids: list[int] | None = None):
+        """Atomic rename when both dentries live in this partition. The move
+        leaves the source quota and enters the destination's."""
+        self._check_lock(("d", src_parent, src_name))
+        self._check_lock(("d", dst_parent, dst_name))
         d = self.dentries.get((src_parent, src_name))
         if d is None:
             raise NoEntry(f"{src_name!r} in {src_parent}")
         if (dst_parent, dst_name) in self.dentries:
             raise Exists(f"{dst_name!r} in {dst_parent}")
-        self._op_create_dentry(dst_parent, dst_name, d.ino, d.mode)
-        self._op_delete_dentry(src_parent, src_name)
+        self._op_create_dentry(dst_parent, dst_name, d.ino, d.mode,
+                               quota_ids=dst_quota_ids)
+        self._op_delete_dentry(src_parent, src_name, quota_ids=src_quota_ids)
         return self.dentries[(dst_parent, dst_name)]
 
     def _op_link(self, parent: int, name: str, ino: int):
@@ -342,6 +440,180 @@ class MetaPartitionSM(StateMachine):
         done = set(seqs)
         self.del_extents = [(s, e) for s, e in self.del_extents if s not in done]
         return len(done)
+
+    # -- fsm ops: transactions (metanode/transaction.go 2PC) --------------------
+    #
+    # prepare validates every sub-op and takes intent locks; commit replays the
+    # sub-ops with the locks held (so they cannot fail); rollback/expiry drops
+    # the intents. Deadlines ride the PROPOSAL (deterministic across replicas).
+    #
+    # Coordinator recovery (the reference's TM/RM split): every txn names a
+    # TRANSACTION-MANAGER partition (tm_pid). The coordinator commits the TM
+    # first — the TM's tx_done entry IS the durable decision. A participant
+    # whose prepared txn expires does not abort unilaterally: the sweep hands
+    # it to the metanode, which asks the TM partition and rolls the txn
+    # forward (commit) or back to match. Only the TM's own expiry decides
+    # "rolledback" (the coordinator died before any commit).
+
+    TX_OPS = {"create_dentry", "delete_dentry"}
+
+    @staticmethod
+    def _tx_lock_keys(op: str, args: dict) -> list[tuple]:
+        keys = [("d", args["parent"], args["name"])]
+        if op == "delete_dentry" and args.get("_lock_children"):
+            # deleting a DIRECTORY: freeze its child set too, or a create
+            # inside it between prepare and commit breaks the "commit cannot
+            # fail" invariant (the validation checked it was empty)
+            keys.append(("c", args["_lock_children"]))
+        return keys
+
+    def _op_tx_prepare(self, tx_id: str, ops: list, deadline: float,
+                       tm_pid: int = 0):
+        if tx_id in self.tx_done:
+            raise TxConflict(f"txn {tx_id} already {self.tx_done[tx_id]}")
+        if tx_id in self.txns:
+            return None  # idempotent re-prepare
+        prepared_ops = []
+        for op, args in ops:
+            if op not in self.TX_OPS:
+                raise MetaError(f"op {op!r} not transactable")
+            args = dict(args)
+            # dry-run validation so commit cannot fail later
+            if op == "create_dentry":
+                if (args["parent"], args["name"]) in self.dentries:
+                    raise Exists(f"{args['name']!r} exists in {args['parent']}")
+                pdir = self._get_inode(args["parent"])
+                if not pdir.is_dir:
+                    raise NotDir(f"parent {args['parent']}")
+                self._quota_check_files(args.get("quota_ids"))
+            elif op == "delete_dentry":
+                d = self.dentries.get((args["parent"], args["name"]))
+                if d is None:
+                    raise NoEntry(f"{args['name']!r} in {args['parent']}")
+                if stat_mod.S_ISDIR(d.mode):
+                    if self.children.get(d.ino):
+                        raise NotEmpty(args["name"])
+                    args["_lock_children"] = d.ino
+            for key in self._tx_lock_keys(op, args):
+                self._check_lock(key)
+            prepared_ops.append((op, args))
+        for op, args in prepared_ops:
+            for key in self._tx_lock_keys(op, args):
+                self.tx_locks[key] = tx_id
+        self.txns[tx_id] = {"ops": prepared_ops, "deadline": deadline,
+                            "tm_pid": tm_pid or self.partition_id}
+        return None
+
+    def _release_tx(self, tx_id: str):
+        self.tx_locks = {k: t for k, t in self.tx_locks.items() if t != tx_id}
+        self.txns.pop(tx_id, None)
+        if len(self.tx_done) > 1024:  # bounded memory of finished txns
+            for k in list(self.tx_done)[:512]:
+                del self.tx_done[k]
+
+    def _op_tx_commit(self, tx_id: str):
+        if self.tx_done.get(tx_id) == "committed":
+            return None  # idempotent re-commit
+        txn = self.txns.get(tx_id)
+        if txn is None:
+            raise TxConflict(f"txn {tx_id} not prepared "
+                             f"({self.tx_done.get(tx_id, 'unknown')})")
+        for op, args in txn["ops"]:
+            run_args = {k: v for k, v in args.items() if k != "_lock_children"}
+            getattr(self, "_op_" + op)(**run_args, _tx=tx_id)
+        self.tx_done[tx_id] = "committed"
+        self._release_tx(tx_id)
+        return None
+
+    def _op_tx_rollback(self, tx_id: str):
+        if tx_id in self.txns:
+            self.tx_done[tx_id] = "rolledback"
+            self._release_tx(tx_id)
+        return None
+
+    def _op_tx_sweep(self, now: float):
+        """Resolve expired prepared txns. TM-anchored txns roll back here (no
+        commit decision was ever recorded); participant txns are RETURNED for
+        the metanode to resolve against their TM partition."""
+        unresolved = []
+        for t, txn in list(self.txns.items()):
+            if txn["deadline"] >= now:
+                continue
+            if txn["tm_pid"] == self.partition_id:
+                self.tx_done[t] = "rolledback"
+                self._release_tx(t)
+            else:
+                unresolved.append((t, txn["tm_pid"]))
+        return unresolved
+
+    def tx_status(self, tx_id: str) -> str:
+        """TM-side decision lookup: committed | rolledback | prepared | unknown."""
+        if tx_id in self.tx_done:
+            return self.tx_done[tx_id]
+        if tx_id in self.txns:
+            return "prepared"
+        return "unknown"
+
+    # -- fsm ops: quotas (metanode quota + master_quota_manager) ----------------
+    #
+    # A quota id names a directory subtree. Definitions are fanned out to every
+    # partition of the volume; usage is counted where the charged op applies
+    # (files at the dentry's partition — exact, because one directory's
+    # dentries live on one partition; bytes at the inode's partition). The
+    # aggregator (MetaWrapper.quota_usage) sums partitions and pushes the
+    # `exceeded` flag back down, the reference's master-report loop shape.
+
+    def _op_set_quota_def(self, quota_id: int, max_files: int = 0,
+                          max_bytes: int = 0):
+        q = self.quotas.setdefault(
+            quota_id, {"files": 0, "bytes": 0, "exceeded": False})
+        q["max_files"] = max_files
+        q["max_bytes"] = max_bytes
+        return None
+
+    def _op_delete_quota_def(self, quota_id: int):
+        self.quotas.pop(quota_id, None)
+        return None
+
+    def _op_set_quota_flag(self, quota_id: int, exceeded: bool):
+        q = self.quotas.get(quota_id)
+        if q is not None:
+            q["exceeded"] = exceeded
+        return None
+
+    def _quota_check_files(self, quota_ids):
+        for qid in quota_ids or ():
+            q = self.quotas.get(qid)
+            if q is None:
+                continue
+            if q["exceeded"] or (q.get("max_files") and
+                                 q["files"] >= q["max_files"]):
+                raise QuotaExceeded(f"quota {qid}: file limit")
+
+    def _quota_charge_files(self, quota_ids, delta: int):
+        if delta > 0:
+            self._quota_check_files(quota_ids)
+        for qid in quota_ids or ():
+            q = self.quotas.get(qid)
+            if q is not None:
+                q["files"] = max(0, q["files"] + delta)
+
+    def _quota_charge_bytes(self, quota_ids, delta: int):
+        for qid in quota_ids or ():  # validate every quota BEFORE charging any
+            q = self.quotas.get(qid)
+            if q is None:
+                continue
+            if delta > 0 and (q["exceeded"] or (
+                    q.get("max_bytes")
+                    and q["bytes"] + delta > q["max_bytes"])):
+                raise QuotaExceeded(f"quota {qid}: byte limit")
+        for qid in quota_ids or ():
+            q = self.quotas.get(qid)
+            if q is not None:
+                q["bytes"] = max(0, q["bytes"] + delta)
+
+    def quota_usage(self) -> dict[int, dict]:
+        return {qid: dict(q) for qid, q in self.quotas.items()}
 
     def _op_multipart_create(self, key: str, upload_id: str):
         self.multipart[upload_id] = {"key": key, "parts": {}}

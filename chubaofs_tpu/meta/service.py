@@ -35,7 +35,8 @@ from chubaofs_tpu.proto.packet import (
 from chubaofs_tpu.raft.server import NotLeaderError
 
 # ops served from leader state without a raft round (metanode read path)
-READ_OPS = {"lookup", "get_inode", "read_dir", "multipart_get", "multipart_list"}
+READ_OPS = {"lookup", "get_inode", "read_dir", "multipart_get",
+            "multipart_list", "quota_usage", "tx_status"}
 
 
 # -- value (de)serialization ---------------------------------------------------
@@ -234,6 +235,13 @@ class RemoteMetaNode:
 
     def multipart_list(self, partition_id: int):
         return self._call(partition_id, "multipart_list")
+
+    def quota_usage(self, partition_id: int):
+        out = self._call(partition_id, "quota_usage")
+        return {int(k): v for k, v in out.items()}  # JSON stringifies int keys
+
+    def tx_status(self, partition_id: int, tx_id: str) -> str:
+        return self._call(partition_id, "tx_status", tx_id=tx_id)
 
     def close(self):
         self._drop_conn()

@@ -69,12 +69,29 @@ class FsClient:
 
     # -- directory verbs --------------------------------------------------------
 
+    def _parent_quota_ids(self, parent: int) -> list[int]:
+        try:
+            return self.meta.quota_ids_of(parent)
+        except OpError:
+            return []
+
+    def _undo_create(self, ino: int) -> None:
+        """A failed dentry insert must not leak the fresh inode."""
+        try:
+            self.meta.unlink_inode(ino)
+            self.meta.evict_inode(ino)
+        except OpError:
+            pass  # freelist sweeps catch stragglers
+
     def mkdir(self, path: str, mode: int = 0o755) -> int:
         parent, name = self._resolve_parent(path)
-        inode = self.meta.create_inode(stat_mod.S_IFDIR | mode)
+        qids = self._parent_quota_ids(parent)
+        inode = self.meta.create_inode(stat_mod.S_IFDIR | mode, quota_ids=qids)
         try:
-            self.meta.create_dentry(parent, name, inode.ino, inode.mode)
+            self.meta.create_dentry(parent, name, inode.ino, inode.mode,
+                                    quota_ids=qids)
         except OpError as e:
+            self._undo_create(inode.ino)
             raise FsError(e.code, path) from None
         return inode.ino
 
@@ -88,12 +105,16 @@ class FsClient:
                     raise FsError("ENOTDIR", path)
                 ino = d.ino
             except OpError:
-                child = self.meta.create_inode(stat_mod.S_IFDIR | mode)
+                qids = self._parent_quota_ids(ino)
+                child = self.meta.create_inode(stat_mod.S_IFDIR | mode,
+                                               quota_ids=qids)
                 try:
-                    self.meta.create_dentry(ino, part, child.ino, child.mode)
+                    self.meta.create_dentry(ino, part, child.ino, child.mode,
+                                            quota_ids=qids)
                     ino = child.ino
                 except OpError:
                     # lost a create race: take whoever won
+                    self._undo_create(child.ino)
                     ino = self.meta.lookup(ino, part).ino
         return ino
 
@@ -109,7 +130,8 @@ class FsClient:
             d = self.meta.lookup(parent, name)
             if not stat_mod.S_ISDIR(d.mode):
                 raise FsError("ENOTDIR", path)
-            self.meta.delete_dentry(parent, name)
+            self.meta.delete_dentry(parent, name,
+                                    quota_ids=self._parent_quota_ids(parent))
         except OpError as e:
             raise FsError(e.code, path) from None
         self.meta.unlink_inode(d.ino)
@@ -119,10 +141,13 @@ class FsClient:
 
     def create(self, path: str, mode: int = 0o644) -> int:
         parent, name = self._resolve_parent(path)
-        inode = self.meta.create_inode(stat_mod.S_IFREG | mode)
+        qids = self._parent_quota_ids(parent)
+        inode = self.meta.create_inode(stat_mod.S_IFREG | mode, quota_ids=qids)
         try:
-            self.meta.create_dentry(parent, name, inode.ino, inode.mode)
+            self.meta.create_dentry(parent, name, inode.ino, inode.mode,
+                                    quota_ids=qids)
         except OpError as e:
+            self._undo_create(inode.ino)
             raise FsError(e.code, path) from None
         return inode.ino
 
@@ -148,14 +173,17 @@ class FsClient:
 
     def write_at(self, ino: int, offset: int, data: bytes) -> None:
         """Positional write, tier-dispatched (file.go:367-439 Write analog)."""
-        if not self.cold:
-            self.hot.write(ino, offset, data)
-            return
-        if offset != self.meta.get_inode(ino).size:
-            raise FsError("EINVAL", "cold volumes are append-only")
-        loc = self.data.write(data)
-        self.meta.append_obj_extents(
-            ino, [{"loc": loc, "size": len(data)}], offset + len(data))
+        try:
+            if not self.cold:
+                self.hot.write(ino, offset, data)
+                return
+            if offset != self.meta.get_inode(ino).size:
+                raise FsError("EINVAL", "cold volumes are append-only")
+            loc = self.data.write(data)
+            self.meta.append_obj_extents(
+                ino, [{"loc": loc, "size": len(data)}], offset + len(data))
+        except OpError as e:  # e.g. EDQUOT from the quota charge
+            raise FsError(e.code, f"ino {ino}") from None
 
     def read_file(self, path: str, offset: int = 0, size: int | None = None) -> bytes:
         return self.read_at(self.resolve(path), offset, size)
@@ -214,7 +242,8 @@ class FsClient:
             d = self.meta.lookup(parent, name)
             if stat_mod.S_ISDIR(d.mode):
                 raise FsError("EISDIR", path)
-            self.meta.delete_dentry(parent, name)
+            self.meta.delete_dentry(parent, name,
+                                    quota_ids=self._parent_quota_ids(parent))
         except OpError as e:
             raise FsError(e.code, path) from None
         self.meta.unlink_inode(d.ino)
@@ -233,7 +262,9 @@ class FsClient:
         sp, sn = self._resolve_parent(src)
         dp, dn = self._resolve_parent(dst)
         try:
-            self.meta.rename(sp, sn, dp, dn)
+            self.meta.rename(sp, sn, dp, dn,
+                             src_quota_ids=self._parent_quota_ids(sp),
+                             dst_quota_ids=self._parent_quota_ids(dp))
         except OpError as e:
             raise FsError(e.code, f"{src} -> {dst}") from None
 
@@ -262,13 +293,22 @@ class FsClient:
             raise FsError(e.code, new) from None
 
     def setxattr(self, path: str, key: str, value: bytes) -> None:
-        self.meta.set_xattr(self.resolve(path), key, value)
+        try:
+            self.meta.set_xattr(self.resolve(path), key, value)
+        except OpError as e:
+            raise FsError(e.code, path) from None
 
     def getxattr(self, path: str, key: str) -> bytes:
-        inode = self.meta.get_inode(self.resolve(path))
+        try:
+            inode = self.meta.get_inode(self.resolve(path))
+        except OpError as e:
+            raise FsError(e.code, path) from None
         if key not in inode.xattrs:
             raise FsError("ENODATA", key)
         return inode.xattrs[key]
 
     def removexattr(self, path: str, key: str) -> None:
-        self.meta.remove_xattr(self.resolve(path), key)
+        try:
+            self.meta.remove_xattr(self.resolve(path), key)
+        except OpError as e:
+            raise FsError(e.code, path) from None

@@ -17,9 +17,17 @@ from chubaofs_tpu.raft.server import NotLeaderError
 
 class MetaWrapper:
     def __init__(self, master, metanodes: dict[int, MetaNode], volume: str):
+        import itertools
+        import uuid
+
         self.master = master
         self.metanodes = metanodes
         self.volume = volume
+        # uniq-op identity (metanode/uniq_checker.go): every mutation carries
+        # (client_id, seq) so a retried delivery replays the recorded answer
+        # instead of double-applying — which is what makes EIO retries safe
+        self.client_id = uuid.uuid4().hex[:16]
+        self._uniq = itertools.count(1)
 
     # -- routing ---------------------------------------------------------------
 
@@ -89,23 +97,27 @@ class MetaWrapper:
         raise last or MasterError(f"partition {mp.partition_id}: no leader reachable")
 
     def submit(self, mp: MetaPartitionView, op: str, **args):
+        # the uniq id makes the mutation idempotent end-to-end, so even an
+        # after-send connection loss (EIO) may retry safely
+        args["_uniq"] = (self.client_id, next(self._uniq))
         return self._on_partition(
             mp, lambda node: node.submit_sync(mp.partition_id, op, **args),
-            idempotent=False,
+            idempotent=True,
         )
 
     # -- the ll API (api.go analogs) -------------------------------------------
 
-    def create_inode(self, mode: int, uid: int = 0, gid: int = 0):
+    def create_inode(self, mode: int, uid: int = 0, gid: int = 0,
+                     quota_ids: list[int] | None = None):
         mp = self.tail_partition()
-        return self._on_partition(
-            mp, lambda n: n.submit_sync(mp.partition_id, "create_inode", mode=mode, uid=uid, gid=gid),
-            idempotent=False,
-        )
+        return self.submit(mp, "create_inode", mode=mode, uid=uid, gid=gid,
+                           quota_ids=quota_ids or [])
 
-    def create_dentry(self, parent: int, name: str, ino: int, mode: int):
+    def create_dentry(self, parent: int, name: str, ino: int, mode: int,
+                      quota_ids: list[int] | None = None):
         mp = self.partition_of(parent)
-        return self.submit(mp, "create_dentry", parent=parent, name=name, ino=ino, mode=mode)
+        return self.submit(mp, "create_dentry", parent=parent, name=name,
+                           ino=ino, mode=mode, quota_ids=quota_ids or [])
 
     def lookup(self, parent: int, name: str):
         mp = self.partition_of(parent)
@@ -119,9 +131,11 @@ class MetaWrapper:
         mp = self.partition_of(parent)
         return self._on_partition(mp, lambda n: n.read_dir(mp.partition_id, parent))
 
-    def delete_dentry(self, parent: int, name: str):
+    def delete_dentry(self, parent: int, name: str,
+                      quota_ids: list[int] | None = None):
         mp = self.partition_of(parent)
-        return self.submit(mp, "delete_dentry", parent=parent, name=name)
+        return self.submit(mp, "delete_dentry", parent=parent, name=name,
+                           quota_ids=quota_ids or [])
 
     def unlink_inode(self, ino: int):
         mp = self.partition_of(ino)
@@ -147,24 +161,134 @@ class MetaWrapper:
         mp = self.partition_of(ino)
         return self.submit(mp, "append_obj_extents", ino=ino, locations=locations, size=size)
 
-    def rename(self, src_parent: int, src_name: str, dst_parent: int, dst_name: str):
+    TX_TTL = 30.0  # prepared-txn lifetime before peers self-resolve
+
+    def rename(self, src_parent: int, src_name: str, dst_parent: int,
+               dst_name: str, src_quota_ids: list[int] | None = None,
+               dst_quota_ids: list[int] | None = None):
         src_mp = self.partition_of(src_parent)
         dst_mp = self.partition_of(dst_parent)
         if src_mp.partition_id == dst_mp.partition_id:
             return self.submit(
                 src_mp, "rename_local", src_parent=src_parent, src_name=src_name,
                 dst_parent=dst_parent, dst_name=dst_name,
+                src_quota_ids=src_quota_ids or [], dst_quota_ids=dst_quota_ids or [],
             )
-        # cross-partition: create-then-delete (the reference's non-txn fallback;
-        # its transaction framework arrives with the txn layer)
+        # cross-partition: two-phase transaction (metanode/transaction.go).
+        # Prepare takes intent locks + validates on both shards. The DST
+        # partition is the transaction manager: its commit is THE decision —
+        # committed there means every expired participant rolls forward, not
+        # back (metanode sweep asks the TM via tx_status).
+        import time
+        import uuid
+
         d = self._on_partition(src_mp, lambda n: n.lookup(src_mp.partition_id, src_parent, src_name))
-        self.submit(dst_mp, "create_dentry", parent=dst_parent, name=dst_name, ino=d.ino, mode=d.mode)
+        tx_id = f"tx-{self.client_id}-{uuid.uuid4().hex[:12]}"
+        deadline = time.time() + self.TX_TTL
+        tm_pid = dst_mp.partition_id
+        plans = [
+            (dst_mp, [("create_dentry",
+                       {"parent": dst_parent, "name": dst_name,
+                        "ino": d.ino, "mode": d.mode,
+                        "quota_ids": dst_quota_ids or []})]),
+            (src_mp, [("delete_dentry",
+                       {"parent": src_parent, "name": src_name,
+                        "quota_ids": src_quota_ids or []})]),
+        ]
+        prepared = []
         try:
-            return self.submit(src_mp, "delete_dentry", parent=src_parent, name=src_name)
+            for mp, ops in plans:
+                self.submit(mp, "tx_prepare", tx_id=tx_id, ops=ops,
+                            deadline=deadline, tm_pid=tm_pid)
+                prepared.append(mp)
         except OpError:
-            # undo on failure
-            self.submit(dst_mp, "delete_dentry", parent=dst_parent, name=dst_name)
+            for mp in prepared:
+                try:
+                    self.submit(mp, "tx_rollback", tx_id=tx_id)
+                except OpError:
+                    pass  # expiry sweep covers it
             raise
+        # TM commit first — the point of no return. After it lands, participant
+        # commits are best-effort: the sweep rolls any straggler forward.
+        self.submit(dst_mp, "tx_commit", tx_id=tx_id)
+        try:
+            self.submit(src_mp, "tx_commit", tx_id=tx_id)
+        except OpError:
+            pass  # resolved by the participant sweep against the TM
+        return None
+
+    # -- directory quotas (master_quota_manager + metanode quota analog) --------
+
+    def set_quota(self, dir_ino: int, quota_id: int, max_files: int = 0,
+                  max_bytes: int = 0) -> None:
+        """Define a subtree quota: fan the definition to every partition (usage
+        is charged wherever the op lands) and tag the directory inode."""
+        for mp in self._view().meta_partitions:
+            self.submit(mp, "set_quota_def", quota_id=quota_id,
+                        max_files=max_files, max_bytes=max_bytes)
+        ids = self.quota_ids_of(dir_ino)
+        if quota_id not in ids:
+            ids.append(quota_id)
+        import json as _json
+
+        self.set_xattr(dir_ino, "__quota_ids__", _json.dumps(ids).encode())
+
+    def delete_quota(self, quota_id: int) -> None:
+        for mp in self._view().meta_partitions:
+            self.submit(mp, "delete_quota_def", quota_id=quota_id)
+
+    def quota_ids_of(self, dir_ino: int) -> list[int]:
+        """The quota ids a child of dir_ino inherits (client-side resolution,
+        the reference's quota-id cache shape)."""
+        import json as _json
+
+        inode = self.get_inode(dir_ino)
+        raw = inode.xattrs.get("__quota_ids__")
+        return _json.loads(raw) if raw else []
+
+    def quota_usage(self, quota_id: int) -> dict:
+        """Aggregate usage across partitions (the master report loop's sum)."""
+        total = {"files": 0, "bytes": 0}
+        for mp in self._view().meta_partitions:
+            for node_usage in [self._on_partition(
+                    mp, lambda n, _mp=mp: n.quota_usage(_mp.partition_id))]:
+                q = node_usage.get(quota_id)
+                if q:
+                    total["files"] += q["files"]
+                    total["bytes"] += q["bytes"]
+        return total
+
+    def push_quota_flags(self) -> None:
+        """Re-evaluate aggregated usage and distribute `exceeded` flags — one
+        round of the reference's master quota report loop. Also re-fans quota
+        DEFINITIONS to partitions that miss them (a tail split creates new
+        partitions after set_quota ran; until this heals, the new partition
+        silently skips those quota ids)."""
+        defs: dict[int, dict] = {}
+        usage: dict[int, dict] = {}
+        per_mp: dict[int, set[int]] = {}
+        mps = self._view().meta_partitions
+        for mp in mps:
+            node_usage = self._on_partition(
+                mp, lambda n, _mp=mp: n.quota_usage(_mp.partition_id))
+            per_mp[mp.partition_id] = set(node_usage)
+            for qid, q in node_usage.items():
+                defs[qid] = q
+                agg = usage.setdefault(qid, {"files": 0, "bytes": 0})
+                agg["files"] += q["files"]
+                agg["bytes"] += q["bytes"]
+        for qid, agg in usage.items():
+            d = defs[qid]
+            exceeded = bool(
+                (d.get("max_files") and agg["files"] >= d["max_files"])
+                or (d.get("max_bytes") and agg["bytes"] >= d["max_bytes"]))
+            for mp in mps:
+                if qid not in per_mp.get(mp.partition_id, ()):
+                    self.submit(mp, "set_quota_def", quota_id=qid,
+                                max_files=d.get("max_files", 0),
+                                max_bytes=d.get("max_bytes", 0))
+                self.submit(mp, "set_quota_flag", quota_id=qid,
+                            exceeded=exceeded)
 
     def link(self, parent: int, name: str, ino: int):
         mp = self.partition_of(parent)

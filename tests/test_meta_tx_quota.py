@@ -1,0 +1,245 @@
+"""Metanode transactions (2PC), uniq-op idempotence, directory quotas
+(metanode/transaction.go, uniq_checker.go, quota + master_quota_manager)."""
+
+import stat
+
+import pytest
+
+from chubaofs_tpu.deploy import FsCluster
+from chubaofs_tpu.meta.partition import MetaPartitionSM
+from chubaofs_tpu.sdk.fs import FsError
+
+
+# -- uniq checker (SM level) ---------------------------------------------------
+
+
+def mk_sm():
+    return MetaPartitionSM(1, 1, 1 << 20)
+
+
+def test_uniq_duplicate_replays_result():
+    sm = mk_sm()
+    args = {"mode": stat.S_IFREG | 0o644, "_uniq": ("c1", 7)}
+    r1 = sm.apply(("create_inode", args), 1)
+    r2 = sm.apply(("create_inode", args), 2)  # duplicate delivery
+    assert r1 == r2  # same inode, not a second one
+    assert sm.cursor == 2  # only one allocation happened (root is ino 1)
+
+
+def test_uniq_errors_replayed_too():
+    sm = mk_sm()
+    args = {"parent": 1, "name": "nope", "_uniq": ("c1", 1)}
+    r1 = sm.apply(("delete_dentry", args), 1)
+    r2 = sm.apply(("delete_dentry", args), 2)
+    assert r1[0] == "err" and r1 == r2
+
+
+def test_uniq_window_prunes():
+    sm = mk_sm()
+    for i in range(sm.UNIQ_WINDOW + 50):
+        sm.apply(("update_inode", {"ino": 1, "_uniq": ("c1", i)}), i)
+    assert len(sm.uniq_seen["c1"]) == sm.UNIQ_WINDOW
+
+
+# -- 2PC transactions (SM level) -----------------------------------------------
+
+
+def test_tx_prepare_commit():
+    sm = mk_sm()
+    ino = sm.apply(("create_inode", {"mode": stat.S_IFREG | 0o644}), 1)[1].ino
+    ops = [("create_dentry", {"parent": 1, "name": "t", "ino": ino,
+                              "mode": stat.S_IFREG | 0o644})]
+    assert sm.apply(("tx_prepare", {"tx_id": "tx1", "ops": ops,
+                                    "deadline": 1e12}), 2)[0] == "ok"
+    # the intent lock blocks outside writers
+    r = sm.apply(("create_dentry", {"parent": 1, "name": "t", "ino": ino,
+                                    "mode": 0o644}), 3)
+    assert r[:2] == ("err", "ETXCONFLICT")
+    assert sm.apply(("tx_commit", {"tx_id": "tx1"}), 4)[0] == "ok"
+    assert (1, "t") in sm.dentries
+    assert not sm.tx_locks
+    # idempotent re-commit
+    assert sm.apply(("tx_commit", {"tx_id": "tx1"}), 5)[0] == "ok"
+
+
+def test_tx_prepare_validates():
+    sm = mk_sm()
+    ops = [("delete_dentry", {"parent": 1, "name": "ghost"})]
+    r = sm.apply(("tx_prepare", {"tx_id": "tx2", "ops": ops,
+                                 "deadline": 1e12}), 1)
+    assert r[:2] == ("err", "ENOENT")
+    assert not sm.txns and not sm.tx_locks
+
+
+def test_tx_rollback_and_expiry():
+    sm = mk_sm()
+    ino = sm.apply(("create_inode", {"mode": stat.S_IFREG | 0o644}), 1)[1].ino
+    ops = [("create_dentry", {"parent": 1, "name": "r", "ino": ino,
+                              "mode": 0o644})]
+    sm.apply(("tx_prepare", {"tx_id": "tx3", "ops": ops, "deadline": 1e12}), 2)
+    sm.apply(("tx_rollback", {"tx_id": "tx3"}), 3)
+    assert not sm.tx_locks and (1, "r") not in sm.dentries
+    # a rolled-back txn cannot be committed later (coordinator came back)
+    assert sm.apply(("tx_commit", {"tx_id": "tx3"}), 4)[:2] == ("err", "ETXCONFLICT")
+    # expiry sweep: a TM-anchored txn (tm defaults to this partition) rolls
+    # back locally — the coordinator never recorded a commit decision
+    sm.apply(("tx_prepare", {"tx_id": "tx4", "ops": ops, "deadline": 5.0}), 5)
+    assert sm.apply(("tx_sweep", {"now": 10.0}), 6) == ("ok", [])
+    assert not sm.txns and sm.tx_done["tx4"] == "rolledback"
+
+
+def test_tx_participant_expiry_resolves_via_tm():
+    """A participant partition never aborts unilaterally: the sweep surfaces
+    the txn, and the decision comes from the TM (coordinator recovery)."""
+    sm = mk_sm()
+    ino = sm.apply(("create_inode", {"mode": stat.S_IFREG | 0o644}), 1)[1].ino
+    ops = [("create_dentry", {"parent": 1, "name": "p", "ino": ino,
+                              "mode": 0o644})]
+    sm.apply(("tx_prepare", {"tx_id": "tx9", "ops": ops, "deadline": 5.0,
+                             "tm_pid": 999}), 2)
+    unresolved = sm.apply(("tx_sweep", {"now": 10.0}), 3)
+    assert unresolved == ("ok", [("tx9", 999)])
+    assert "tx9" in sm.txns  # still prepared, locks still held
+    # the metanode resolves: TM says committed -> roll FORWARD
+    assert sm.apply(("tx_commit", {"tx_id": "tx9"}), 4)[0] == "ok"
+    assert (1, "p") in sm.dentries
+
+
+def test_tx_dir_delete_locks_child_set():
+    """Prepared delete of an empty dir freezes its child set, so commit's
+    'cannot fail' invariant holds against concurrent creates inside it."""
+    sm = mk_sm()
+    d_ino = sm.apply(("create_inode", {"mode": stat.S_IFDIR | 0o755}), 1)[1].ino
+    sm.apply(("create_dentry", {"parent": 1, "name": "dir", "ino": d_ino,
+                                "mode": stat.S_IFDIR | 0o755}), 2)
+    ops = [("delete_dentry", {"parent": 1, "name": "dir"})]
+    assert sm.apply(("tx_prepare", {"tx_id": "txd", "ops": ops,
+                                    "deadline": 1e12}), 3)[0] == "ok"
+    f_ino = sm.apply(("create_inode", {"mode": stat.S_IFREG | 0o644}), 4)[1].ino
+    r = sm.apply(("create_dentry", {"parent": d_ino, "name": "sneak",
+                                    "ino": f_ino, "mode": 0o644}), 5)
+    assert r[:2] == ("err", "ETXCONFLICT")
+    assert sm.apply(("tx_commit", {"tx_id": "txd"}), 6)[0] == "ok"
+    assert (1, "dir") not in sm.dentries
+
+
+# -- cross-partition rename through the cluster --------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = FsCluster(str(tmp_path_factory.mktemp("txq")), n_nodes=3, blob_nodes=6,
+                  data_nodes=0)
+    c.create_volume("tv", cold=True)
+    yield c
+    c.close()
+
+
+def _force_split(cluster, vol="tv"):
+    """Grow the namespace until the master splits the tail partition."""
+    lead = cluster.master()
+    for mn in cluster.metanodes.values():
+        for pid, sm in mn.partitions.items():
+            lead.heartbeat(mn.node_id, cursors={pid: sm.cursor})
+    from chubaofs_tpu.master import master as master_mod
+
+    old_step, old_headroom = master_mod.META_RANGE_STEP, master_mod.SPLIT_HEADROOM
+    master_mod.META_RANGE_STEP, master_mod.SPLIT_HEADROOM = 64, 8
+    try:
+        fs = cluster.client(vol)
+        fs.mkdirs("/split-filler")
+        for i in range(80):
+            fs.create(f"/split-filler/f{i}")
+        for mn in cluster.metanodes.values():
+            for pid, sm in mn.partitions.items():
+                lead.heartbeat(mn.node_id, cursors={pid: sm.cursor})
+        assert lead.check_meta_partitions() >= 1
+    finally:
+        master_mod.META_RANGE_STEP, master_mod.SPLIT_HEADROOM = old_step, old_headroom
+
+
+def test_cross_partition_rename_via_2pc(cluster):
+    fs = cluster.client("tv")
+    fs.mkdirs("/a")
+    _force_split(cluster)
+    # a directory on the NEW tail partition: its dentries live there
+    fs2 = cluster.client("tv")
+    fs2.mkdirs("/b")
+    ino_a = fs2.resolve("/a")
+    ino_b = fs2.resolve("/b")
+    mp_a = fs2.meta.partition_of(ino_a).partition_id
+    mp_b = fs2.meta.partition_of(ino_b).partition_id
+    assert mp_a != mp_b, "need a genuinely cross-partition rename"
+
+    fs2.write_file("/a/x.bin", b"payload")
+    fs2.rename("/a/x.bin", "/b/y.bin")
+    assert fs2.read_file("/b/y.bin") == b"payload"
+    assert "x.bin" not in fs2.readdir("/a")
+
+    # follower replicas apply the commit on subsequent ticks; pump the clock,
+    # then no intent locks may remain anywhere
+    def no_locks():
+        return all(not sm.tx_locks and not sm.txns
+                   for mn in cluster.metanodes.values()
+                   for sm in mn.partitions.values())
+
+    assert cluster.settle(no_locks)
+
+
+# -- quotas --------------------------------------------------------------------
+
+
+def test_quota_max_files(cluster):
+    fs = cluster.client("tv")
+    fs.mkdirs("/q1")
+    dir_ino = fs.resolve("/q1")
+    fs.meta.set_quota(dir_ino, quota_id=11, max_files=3)
+    for i in range(3):
+        fs.create(f"/q1/f{i}")
+    with pytest.raises(FsError) as e:
+        fs.create("/q1/f3")
+    assert e.value.code == "EDQUOT"
+    # deleting frees the budget
+    fs.unlink("/q1/f0")
+    fs.create("/q1/f3")
+    usage = fs.meta.quota_usage(11)
+    assert usage["files"] == 3
+
+
+def test_quota_max_bytes(cluster):
+    fs = cluster.client("tv")
+    fs.mkdirs("/q2")
+    fs.meta.set_quota(fs.resolve("/q2"), quota_id=12, max_bytes=1000)
+    fs.write_file("/q2/a", b"x" * 900)
+    with pytest.raises(FsError) as e:
+        fs.append_file("/q2/a", b"y" * 900)
+    assert e.value.code == "EDQUOT"
+    assert fs.meta.quota_usage(12)["bytes"] == 900
+    # truncate credits the budget back
+    fs.meta.truncate(fs.resolve("/q2/a"), 0)
+    assert fs.meta.quota_usage(12)["bytes"] == 0
+    fs.write_file("/q2/b", b"z" * 500)
+
+
+def test_quota_inherited_by_subdirs(cluster):
+    fs = cluster.client("tv")
+    fs.mkdirs("/q3")
+    fs.meta.set_quota(fs.resolve("/q3"), quota_id=13, max_files=2)
+    fs.mkdir("/q3/sub")  # counts as one file
+    fs.create("/q3/sub/leaf")  # inherited: counts too
+    with pytest.raises(FsError) as e:
+        fs.create("/q3/sub/leaf2")
+    assert e.value.code == "EDQUOT"
+
+
+def test_quota_flag_push(cluster):
+    fs = cluster.client("tv")
+    fs.mkdirs("/q4")
+    fs.meta.set_quota(fs.resolve("/q4"), quota_id=14, max_files=1)
+    fs.create("/q4/only")
+    fs.meta.push_quota_flags()
+    with pytest.raises(FsError):
+        fs.create("/q4/more")
+    fs.unlink("/q4/only")
+    fs.meta.push_quota_flags()  # usage back under: flag clears
+    fs.create("/q4/again")
