@@ -547,6 +547,22 @@ class AuthNodeDaemon(_Daemon):
         self.net.close()
 
 
+class ConsoleDaemon(_Daemon):
+    """Role console (console/server.go analog)."""
+
+    def __init__(self, cfg: dict):
+        super().__init__()
+        from chubaofs_tpu.console import Console
+
+        host, port = _addr_split(cfg.get("listen", "127.0.0.1:0"))
+        self.console = Console(cfg["masterAddrs"], host=host, port=port)
+        self.addr = self.console.addr
+
+    def stop(self):
+        super().stop()
+        self.console.stop()
+
+
 ROLES = {
     "master": MasterDaemon,
     "metanode": MetaNodeDaemon,
@@ -554,6 +570,7 @@ ROLES = {
     "blobstore": BlobstoreDaemon,
     "objectnode": ObjectNodeDaemon,
     "authnode": AuthNodeDaemon,
+    "console": ConsoleDaemon,
 }
 
 

@@ -1,0 +1,5 @@
+"""Console — the web dashboard (console/ analog)."""
+
+from chubaofs_tpu.console.server import Console
+
+__all__ = ["Console"]

@@ -1,0 +1,150 @@
+"""Console — web dashboard over the master's GraphQL API.
+
+Reference counterpart: console/ (console/server.go:110 — a small HTTP server
+hosting a SPA plus a GraphQL proxy to the master; console/service/). Kept:
+the same split — static dashboard at /, a /graphql proxy that forwards the
+browser's queries to the master (following leader redirects via
+MasterClient's transport), and JSON convenience endpoints the dashboard
+polls. The SPA is a single inline page: tables for nodes, volumes and users,
+refreshed from /api/overview.
+"""
+
+from __future__ import annotations
+
+import json
+
+from chubaofs_tpu.master.api_service import MasterClient
+from chubaofs_tpu.rpc.errors import HTTPError
+from chubaofs_tpu.rpc.router import Request, Response, Router
+from chubaofs_tpu.rpc.server import RPCServer
+
+PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>chubaofs-tpu console</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:2rem;background:#fafafa;color:#222}
+ h1{font-size:1.4rem} h2{font-size:1.05rem;margin-top:1.5rem}
+ table{border-collapse:collapse;min-width:40rem;background:#fff}
+ th,td{border:1px solid #ddd;padding:.35rem .6rem;text-align:left;font-size:.9rem}
+ th{background:#f0f0f0} .ok{color:#0a7d38} .warn{color:#b54708}
+ #err{color:#b42318;margin:.5rem 0}
+</style></head><body>
+<h1>chubaofs-tpu console</h1>
+<div id="err"></div>
+<h2>Cluster</h2><div id="cluster"></div>
+<h2>Nodes</h2><table id="nodes"></table>
+<h2>Volumes</h2><table id="volumes"></table>
+<h2>Users</h2><table id="users"></table>
+<script>
+function esc(v){
+  return String(v).replace(/[&<>"']/g,
+    ch=>({"&":"&amp;","<":"&lt;",">":"&gt;",'"':"&quot;","'":"&#39;"}[ch]));
+}
+function row(cells, tag){
+  return "<tr>"+cells.map(c=>"<"+tag+">"+c+"</"+tag+">").join("")+"</tr>";
+}
+async function refresh(){
+  try{
+    const r = await fetch("/api/overview"); const d = await r.json();
+    document.getElementById("err").textContent = "";
+    const cv = d.clusterView;
+    document.getElementById("cluster").textContent =
+      "leader: node "+cv.leaderID+" · volumes: "+cv.volumeCount+
+      " · nodes: "+cv.nodes.length;
+    const now = Date.now()/1000;
+    document.getElementById("nodes").innerHTML =
+      row(["id","kind","addr","partitions","alive"],"th")+
+      cv.nodes.map(n=>row([esc(n.id),esc(n.kind),esc(n.addr),esc(n.partitions),
+        (now-n.lastHeartbeat<10)?"<span class=ok>yes</span>"
+                               :"<span class=warn>stale</span>"],"td")).join("");
+    document.getElementById("volumes").innerHTML =
+      row(["name","owner","tier","meta partitions","data partitions"],"th")+
+      d.volumeList.map(v=>row([esc(v.name),esc(v.owner||"-"),
+        v.cold?"cold(EC)":"hot",
+        v.metaPartitions.length,v.dataPartitions.length],"td")).join("");
+    document.getElementById("users").innerHTML =
+      row(["user","type","access key","own volumes"],"th")+
+      d.userList.map(u=>row([esc(u.userID),esc(u.userType),esc(u.accessKey),
+        esc(u.ownVols.join(", ")||"-")],"td")).join("");
+  }catch(e){ document.getElementById("err").textContent = "refresh failed: "+e; }
+}
+refresh(); setInterval(refresh, 3000);
+</script></body></html>"""
+
+OVERVIEW_QUERY = """{
+  clusterView { leaderID volumeCount
+    nodes { id kind addr partitions lastHeartbeat } }
+  volumeList { name owner cold
+    metaPartitions { partitionID } dataPartitions { partitionID } }
+  userList { userID userType accessKey ownVols }
+}"""
+
+
+class Console:
+    def __init__(self, master_addrs: list[str], host: str = "127.0.0.1",
+                 port: int = 0):
+        self.mc = MasterClient(master_addrs)
+        self.router = self._build()
+        self.server = RPCServer(self.router, host=host, port=port).start()
+        self.addr = self.server.addr
+
+    def _graphql(self, query: str, variables=None) -> dict:
+        """Forward to any master replica — /graphql serves reads on followers
+        too, and RPCClient already rotates hosts on connection failure. A 400
+        carries the GraphQL errors array and is returned to the browser."""
+        payload = json.dumps({"query": query,
+                              "variables": variables or {}}).encode()
+        status, _, body = self.mc.rpc.do(
+            "POST", "/graphql", payload,
+            headers={"Content-Type": "application/json"})
+        if status not in (200, 400):
+            raise HTTPError(status, msg=body.decode(errors="replace")[:200])
+        return json.loads(body.decode() or "{}")
+
+    def _build(self) -> Router:
+        r = Router()
+        r.get("/", lambda req: Response(
+            200, {"Content-Type": "text/html; charset=utf-8"}, PAGE.encode()))
+
+        def overview(req: Request):
+            out = self._graphql(OVERVIEW_QUERY)
+            if "errors" in out:
+                return Response.json(out, status=502)
+            return Response.json(out["data"])
+
+        def graphql_proxy(req: Request):
+            body = req.json() or {}
+            return Response.json(self._graphql(body.get("query", ""),
+                                               body.get("variables")))
+
+        r.get("/api/overview", overview)
+        r.post("/graphql", graphql_proxy)
+        return r
+
+    def stop(self):
+        self.server.stop()
+
+
+def main(argv=None) -> int:
+    import argparse
+    import time
+
+    p = argparse.ArgumentParser(prog="cfs-console")
+    p.add_argument("--addr", action="append", required=True,
+                   help="master address (repeatable)")
+    p.add_argument("--listen", default="127.0.0.1:8500")
+    args = p.parse_args(argv)
+    host, port = args.listen.rsplit(":", 1)
+    console = Console(args.addr, host=host, port=int(port))
+    print(json.dumps({"console": console.addr}), flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        console.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

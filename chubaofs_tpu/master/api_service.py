@@ -62,6 +62,9 @@ class MasterAPI:
         g("/user/akInfo", self._w(self.user_ak_info, leader=False))
         g("/user/updatePolicy", self._w(self.user_update_policy))
         g("/user/list", self._w(self.user_list, leader=False))
+        from chubaofs_tpu.master.gapi import GraphQLAPI
+
+        r.post("/graphql", GraphQLAPI(self.master).handle)
         return r
 
     def _w(self, fn, leader: bool = True):

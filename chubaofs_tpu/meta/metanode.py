@@ -123,6 +123,15 @@ class MetaNode:
         except MetaError as e:
             raise OpError(e.code, str(e)) from None
 
+    def dump_namespace(self, partition_id: int):
+        """Full inode+dentry dump of one partition (fsck's feed)."""
+        try:
+            sm = self._leader_sm(partition_id)
+        except MetaError as e:
+            raise OpError(e.code, str(e)) from None
+        return {"inodes": list(sm.inodes.values()),
+                "dentries": list(sm.dentries.values())}
+
     # injected by the deployment: (tm_pid, tx_id) -> "committed" |
     # "rolledback" | "prepared" | "unknown" — asks the TM partition's leader
     tx_resolver_hook = None

@@ -36,7 +36,7 @@ from chubaofs_tpu.raft.server import NotLeaderError
 
 # ops served from leader state without a raft round (metanode read path)
 READ_OPS = {"lookup", "get_inode", "read_dir", "multipart_get",
-            "multipart_list", "quota_usage", "tx_status"}
+            "multipart_list", "quota_usage", "tx_status", "dump_namespace"}
 
 
 # -- value (de)serialization ---------------------------------------------------
@@ -242,6 +242,9 @@ class RemoteMetaNode:
 
     def tx_status(self, partition_id: int, tx_id: str) -> str:
         return self._call(partition_id, "tx_status", tx_id=tx_id)
+
+    def dump_namespace(self, partition_id: int):
+        return self._call(partition_id, "dump_namespace")
 
     def close(self):
         self._drop_conn()

@@ -1,0 +1,2 @@
+"""Operator tools: fsck, authtool, fdstore, preload (fsck/ authtool/
+fdstore/ preload/ analogs)."""

@@ -1,0 +1,204 @@
+"""Operator tools (fsck/fdstore/authtool/autofs/preload) + console/GraphQL."""
+
+import json
+import os
+
+import pytest
+
+from chubaofs_tpu.deploy import FsCluster
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = FsCluster(str(tmp_path_factory.mktemp("tools")), n_nodes=3,
+                  blob_nodes=6, data_nodes=0)
+    c.create_volume("tl", cold=True)
+    yield c
+    c.close()
+
+
+# -- fsck ----------------------------------------------------------------------
+
+
+def test_fsck_clean_tree(cluster):
+    from chubaofs_tpu.tools.fsck import Fsck
+
+    fs = cluster.client("tl")
+    fs.mkdirs("/ok/sub")
+    fs.write_file("/ok/sub/file", b"data")
+    rep = Fsck(fs.meta).check()
+    assert rep.clean, rep.summary()
+    assert rep.inode_count >= 4  # root + 2 dirs + file
+
+
+def test_fsck_detects_and_cleans(cluster):
+    from chubaofs_tpu.tools.fsck import Fsck
+
+    fs = cluster.client("tl")
+    fs.mkdirs("/broken")
+    parent = fs.resolve("/broken")
+    # dangling dentry: points at an inode that was never created
+    fs.meta.create_dentry(parent, "ghost", 999_999, 0o100644)
+    # orphan inode: created, never linked
+    orphan = fs.meta.create_inode(0o100644)
+    # fresh unreferenced inodes are within the mid-creation grace window
+    assert orphan.ino not in Fsck(fs.meta).check().orphan_inodes
+    checker = Fsck(fs.meta, orphan_grace=0.0)
+    rep = checker.check()
+    assert (parent, "ghost", 999_999) in rep.dangling_dentries
+    assert orphan.ino in rep.orphan_inodes
+    rep2 = checker.clean()
+    assert rep2.cleaned >= 2
+    assert checker.check().clean
+
+
+# -- fdstore -------------------------------------------------------------------
+
+
+def test_fdstore_passes_fds(tmp_path):
+    from chubaofs_tpu.tools.fdstore import FdStore, FdStoreClient
+
+    sock = str(tmp_path / "fd.sock")
+    store = FdStore(sock)
+    try:
+        client = FdStoreClient(sock)
+        r, w = os.pipe()
+        os.write(w, b"surviving the upgrade")
+        client.put("mount-1", [r, w])
+        os.close(r)
+        os.close(w)  # the store holds its own duplicates
+
+        assert client.list() == ["mount-1"]
+        # the "new client process" collects the fds back
+        got = client.get("mount-1")
+        assert len(got) == 2
+        assert os.read(got[0], 64) == b"surviving the upgrade"
+        for fd in got:
+            os.close(fd)
+        with pytest.raises(KeyError):
+            client.get("mount-1")  # one-shot handoff
+    finally:
+        store.close()
+
+
+# -- authtool ------------------------------------------------------------------
+
+
+def test_authtool_genkey_and_decode(capsys, cluster):
+    import base64
+
+    from chubaofs_tpu.tools.authtool import main as authtool_main
+
+    assert authtool_main(["genkey"]) == 0
+    key = capsys.readouterr().out.strip()
+    assert len(base64.b64decode(key)) == 32
+
+    # decode a real ticket minted by the in-proc authnode
+    auth = cluster.authnode()
+    ckey = auth.create_key("cli1", "client", caps=["svc:*"])
+    skey = auth.create_key("svc", "service")
+    from chubaofs_tpu.authnode.server import AuthClient
+
+    grant = AuthClient(auth, "cli1", ckey).get_ticket("svc")
+    rc = authtool_main([
+        "decode", grant["ticket"], base64.b64encode(skey).decode(),
+        "--service", "svc"])
+    assert rc == 0
+    claims = json.loads(capsys.readouterr().out)
+    assert claims["client_id"] == "cli1"
+
+
+# -- autofs --------------------------------------------------------------------
+
+
+def test_autofs_map_entry():
+    from chubaofs_tpu.tools.autofs import map_entry_to_config
+
+    cfg = map_entry_to_config(
+        "media", "-fstype=chubaofs,master=m1:17010;m2:17010,vol=media,ro")
+    assert cfg["masterAddr"] == ["m1:17010", "m2:17010"]
+    assert cfg["volName"] == "media"
+    assert cfg["mountPoint"] == "/media"
+    with pytest.raises(ValueError):
+        map_entry_to_config("x", "-fstype=nfs,master=m:1")
+
+
+# -- preload -------------------------------------------------------------------
+
+
+def test_preload_walks_and_reads(cluster):
+    from chubaofs_tpu.tools.preload import Preloader
+
+    fs = cluster.client("tl")
+    fs.mkdirs("/warm/deep")
+    fs.write_file("/warm/a.bin", b"a" * 10_000)
+    fs.write_file("/warm/deep/b.bin", b"b" * 20_000)
+    stats = Preloader(fs, workers=2).run("/warm")
+    assert stats.files == 2 and stats.errors == 0
+    assert stats.bytes == 30_000
+
+
+# -- GraphQL + console ---------------------------------------------------------
+
+
+def test_graphql_queries(cluster):
+    from chubaofs_tpu.master.gapi import GQLError, GraphQLAPI
+
+    api = GraphQLAPI(cluster.master())
+    data = api.execute("""query Overview {
+      clusterView { leaderID nodes { id kind } }
+      volumeList { name cold metaPartitions { partitionID } }
+    }""")
+    assert data["clusterView"]["leaderID"] is not None
+    assert {n["kind"] for n in data["clusterView"]["nodes"]} >= {"meta"}
+    assert any(v["name"] == "tl" and v["cold"] for v in data["volumeList"])
+    # arguments + variables, including a typed variable-definition list
+    data = api.execute('query Q($v: String!) { volume(name: $v) { name owner } }',
+                       {"v": "tl"})
+    assert data["volume"]["name"] == "tl"
+    # UTF-8 string literals survive (no unicode_escape mojibake)
+    with pytest.raises(Exception, match="café"):
+        api.execute('{ volume(name: "café") { name } }')
+    # missing required argument is a GraphQL error, not a 500
+    with pytest.raises(GQLError):
+        api.execute("{ volume { name } }")
+    with pytest.raises(GQLError):
+        api.execute("{ nope }")
+    with pytest.raises(GQLError):
+        api.execute("mutation { hack }")
+
+
+def test_console_over_daemon_master(tmp_path):
+    import urllib.request
+
+    from chubaofs_tpu.cmd import ConsoleDaemon, MasterDaemon
+
+    master = MasterDaemon({
+        "role": "master", "id": 1, "raftPeers": {"1": "127.0.0.1:0"},
+        "listen": "127.0.0.1:0", "walDir": str(tmp_path / "m"),
+    })
+    console = None
+    try:
+        import time
+
+        deadline = time.time() + 10
+        while not master.master.is_leader and time.time() < deadline:
+            time.sleep(0.05)
+        console = ConsoleDaemon({"role": "console",
+                                 "masterAddrs": [master.addr]})
+        page = urllib.request.urlopen(
+            f"http://{console.addr}/", timeout=10).read()
+        assert b"chubaofs-tpu console" in page
+        overview = json.loads(urllib.request.urlopen(
+            f"http://{console.addr}/api/overview", timeout=10).read())
+        assert overview["clusterView"]["leaderID"] == 1
+        req = urllib.request.Request(
+            f"http://{console.addr}/graphql",
+            data=json.dumps({"query": "{ userList { userID } }"}).encode(),
+            headers={"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req, timeout=10).read())
+        assert out["data"]["userList"] == []
+    finally:
+        if console is not None:
+            console.stop()
+        master.stop()
