@@ -307,6 +307,14 @@ class DataNode:
 
     def _op_stream_read(self, pkt: Packet) -> Packet:
         dp = self._dp(pkt)
+        # client reads are leader-only when the partition rides raft: a
+        # follower may not have applied the latest random overwrite yet
+        # (the reference ships followerRead=false by default for the same
+        # reason). Repair reads target specific replicas and skip the gate.
+        if (pkt.opcode == OP_STREAM_READ and dp.raft is not None
+                and not dp.is_raft_leader):
+            return pkt.reply(RES_NOT_LEADER,
+                             arg={"leader": dp.raft.leader_of(dp.pid)})
         size = pkt.arg.get("size", 0)
         data = dp.store.read(pkt.extent_id, pkt.extent_offset, size)
         return pkt.reply(data=data)

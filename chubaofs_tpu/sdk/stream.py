@@ -104,23 +104,36 @@ class ExtentClient:
 
     # -- one-shot requests with leader fallback --------------------------------
 
+    # a raft election (or a restarted replica) makes every host answer
+    # not-leader/conn-refused for a moment; ride it out like the meta client
+    # does (sdk/data retry discipline)
+    RETRY_WINDOW = 10.0
+    RETRY_SLEEP = 0.1
+
     def request(self, dp: dict, pkt: Packet, retry_hosts: bool = True) -> Packet:
+        import time as _time
+
         last = None
         hosts = dp["hosts"] if retry_hosts else dp["hosts"][:1]
-        for addr in hosts:
-            sock = self.pool.get(addr)
-            try:
-                send_packet(sock, pkt)
-                reply = recv_packet(sock)
-            except (OSError, ConnectionError) as e:
-                self.pool.put(addr, sock, ok=False)
-                last = StreamError(f"{addr}: {e}")
-                continue
-            self.pool.put(addr, sock)
-            if reply.result == RES_NOT_LEADER:
-                last = StreamError(f"{addr}: not leader")
-                continue
-            return reply
+        deadline = _time.time() + (self.RETRY_WINDOW if retry_hosts else 0)
+        while True:
+            for addr in hosts:
+                sock = self.pool.get(addr)
+                try:
+                    send_packet(sock, pkt)
+                    reply = recv_packet(sock)
+                except (OSError, ConnectionError) as e:
+                    self.pool.put(addr, sock, ok=False)
+                    last = StreamError(f"{addr}: {e}")
+                    continue
+                self.pool.put(addr, sock)
+                if reply.result == RES_NOT_LEADER:
+                    last = StreamError(f"{addr}: not leader")
+                    continue
+                return reply
+            if _time.time() >= deadline:
+                break
+            _time.sleep(self.RETRY_SLEEP)
         raise last or StreamError("no hosts")
 
 

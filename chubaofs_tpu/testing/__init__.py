@@ -1,0 +1,1 @@
+"""Test harnesses (blobstore/testing + docker/ compose-scripts analog)."""

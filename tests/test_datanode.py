@@ -10,7 +10,8 @@ import pytest
 from chubaofs_tpu.data.datanode import DataNode
 from chubaofs_tpu.proto.packet import (
     OP_CREATE_EXTENT, OP_CREATE_PARTITION, OP_GET_WATERMARKS, OP_MARK_DELETE,
-    OP_RANDOM_WRITE, OP_STREAM_READ, OP_WRITE, Packet, RES_NOT_EXIST, RES_OK,
+    OP_RANDOM_WRITE, OP_REPAIR_READ, OP_STREAM_READ, OP_WRITE, Packet,
+    RES_NOT_EXIST, RES_OK,
     recv_packet, send_packet,
 )
 from chubaofs_tpu.raft.server import InProcNet, MultiRaft, run_until
@@ -180,10 +181,11 @@ class TestChainReplication:
                 data=chunk, arg={"followers": hosts[1:]}))
             assert rep.result == RES_OK, rep.error()
             off += len(chunk)
-        # every replica serves identical bytes (follower read)
+        # every replica holds identical bytes (replica-targeted repair read;
+        # client stream reads are leader-only once raft is attached)
         for addr in hosts:
             rep = _rpc(pool, addr, Packet(
-                OP_STREAM_READ, partition_id=10, extent_id=eid,
+                OP_REPAIR_READ, partition_id=10, extent_id=eid,
                 extent_offset=0, arg={"size": len(payload)}))
             assert rep.result == RES_OK
             assert rep.data == payload
@@ -197,7 +199,7 @@ class TestChainReplication:
         assert 1 <= rep.extent_id <= 64
         for addr in hosts:
             got = _rpc(pool, addr, Packet(
-                OP_STREAM_READ, partition_id=10, extent_id=rep.extent_id,
+                OP_REPAIR_READ, partition_id=10, extent_id=rep.extent_id,
                 extent_offset=rep.extent_offset, arg={"size": 10}))
             assert got.data == b"small file"
 
@@ -215,7 +217,7 @@ class TestChainReplication:
         assert rep.result == RES_OK
         for addr in hosts:
             got = _rpc(pool, addr, Packet(
-                OP_STREAM_READ, partition_id=10, extent_id=eid,
+                OP_REPAIR_READ, partition_id=10, extent_id=eid,
                 extent_offset=0, arg={"size": 6}))
             assert got.result == RES_NOT_EXIST
 
@@ -256,7 +258,7 @@ class TestChainReplication:
         assert run_until(net, all_applied, max_ticks=200)
         for addr in hosts:
             got = _rpc(pool, addr, Packet(
-                OP_STREAM_READ, partition_id=10, extent_id=eid,
+                OP_REPAIR_READ, partition_id=10, extent_id=eid,
                 extent_offset=95, arg={"size": 60}))
             assert got.data == b"0" * 5 + b"X" * 50 + b"0" * 5
 
@@ -283,8 +285,43 @@ class TestChainReplication:
         moved = nodes[0].repair_partition(10)
         assert moved >= 60_000
         got = _rpc(pool, hosts[2], Packet(
-            OP_STREAM_READ, partition_id=10, extent_id=eid, extent_offset=0,
+            OP_REPAIR_READ, partition_id=10, extent_id=eid, extent_offset=0,
             arg={"size": len(payload)}))
         assert got.result == RES_OK, got.error()
         assert got.data == payload
         assert zlib.crc32(got.data) == zlib.crc32(payload)
+
+
+class TestLeaderReadGate:
+    def test_stream_read_is_leader_only(self, trio):
+        """Client stream reads redirect off raft followers (stale-overwrite
+        protection); repair reads still serve from any replica."""
+        from chubaofs_tpu.proto.packet import RES_NOT_LEADER
+
+        nodes, hosts, pool, net = trio
+        rep = _rpc(pool, hosts[0], Packet(
+            OP_CREATE_EXTENT, partition_id=10, arg={"followers": hosts[1:]}))
+        eid = rep.extent_id
+        _rpc(pool, hosts[0], Packet(
+            OP_WRITE, partition_id=10, extent_id=eid, extent_offset=0,
+            data=b"gate", arg={"followers": hosts[1:]}))
+        assert run_until(
+            net, lambda: any(dn.space.partitions[10].is_raft_leader
+                             for dn in nodes), max_ticks=300)
+        leaders = 0
+        for dn, addr in zip(nodes, hosts):
+            got = _rpc(pool, addr, Packet(
+                OP_STREAM_READ, partition_id=10, extent_id=eid,
+                extent_offset=0, arg={"size": 4}))
+            if dn.space.partitions[10].is_raft_leader:
+                assert got.result == RES_OK and got.data == b"gate"
+                leaders += 1
+            else:
+                assert got.result == RES_NOT_LEADER
+                assert got.arg.get("leader") is not None
+            # repair read is replica-targeted and always serves
+            got = _rpc(pool, addr, Packet(
+                OP_REPAIR_READ, partition_id=10, extent_id=eid,
+                extent_offset=0, arg={"size": 4}))
+            assert got.result == RES_OK and got.data == b"gate"
+        assert leaders == 1

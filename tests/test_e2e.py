@@ -1,0 +1,239 @@
+"""End-to-end suite over REAL subprocess daemons (docker/run_docker.sh
+-run_test analog): POSIX semantics battery (the LTP `fs` group's shape),
+multi-master failover, node-kill recovery, and the S3 flow."""
+
+import json
+import time
+
+import pytest
+
+from chubaofs_tpu.client.mount import (
+    Mount,
+    MountError,
+    O_APPEND,
+    O_CREAT,
+    O_RDONLY,
+    O_RDWR,
+    O_TRUNC,
+    O_WRONLY,
+)
+from chubaofs_tpu.sdk.fs import FsError
+from chubaofs_tpu.testing.harness import ProcCluster
+
+pytestmark = pytest.mark.slow
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    c = ProcCluster(str(tmp_path_factory.mktemp("e2e")), masters=3,
+                    metanodes=3, datanodes=3)
+    c.client_master().create_volume("posix", cold=False)
+    yield c
+    c.close()
+
+
+# -- LTP-style POSIX battery ---------------------------------------------------
+
+
+def test_posix_battery(cluster):
+    """The `runltp -f fs` analog: one pass of the POSIX semantics the
+    reference validates on a real mount (docker/script/run_test.sh:213-222)."""
+    mnt = Mount(cluster.fs("posix"), volume="posix")
+
+    # creat01/open01: create, write, reopen, read
+    fd = mnt.open("/f1", O_CREAT | O_RDWR)
+    assert mnt.write(fd, b"alpha") == 5
+    mnt.close(fd)
+    fd = mnt.open("/f1", O_RDONLY)
+    assert mnt.read(fd, 100) == b"alpha"
+    mnt.close(fd)
+
+    # open with O_CREAT on existing file keeps content; O_TRUNC empties
+    fd = mnt.open("/f1", O_CREAT | O_RDONLY)
+    assert mnt.read(fd, 100) == b"alpha"
+    mnt.close(fd)
+    fd = mnt.open("/f1", O_WRONLY | O_TRUNC)
+    mnt.close(fd)
+    assert mnt.stat("/f1")["size"] == 0
+
+    # mkdir01/rmdir01: nested dirs, ENOTEMPTY, ENOENT
+    mnt.mkdir("/d1")
+    mnt.mkdir("/d1/d2")
+    with pytest.raises(FsError) as e:
+        mnt.rmdir("/d1")
+    assert e.value.code == "ENOTEMPTY"
+    mnt.rmdir("/d1/d2")
+    mnt.rmdir("/d1")
+    with pytest.raises(FsError) as e:
+        mnt.readdir("/d1")
+    assert e.value.code == "ENOENT"
+
+    # rename01: file rename replaces path, ENOENT on old
+    fd = mnt.open("/r1", O_CREAT | O_WRONLY)
+    mnt.write(fd, b"rename me")
+    mnt.close(fd)
+    mnt.rename("/r1", "/r2")
+    with pytest.raises(FsError):
+        mnt.stat("/r1")
+    fd = mnt.open("/r2", O_RDONLY)
+    assert mnt.read(fd, 100) == b"rename me"
+    mnt.close(fd)
+
+    # link01: hardlink shares the inode; nlink tracks
+    mnt.link("/r2", "/r2-link")
+    st = mnt.stat("/r2")
+    assert st["nlink"] == 2
+    assert mnt.stat("/r2-link")["ino"] == st["ino"]
+    mnt.unlink("/r2")
+    time.sleep(1.1)  # attr cache TTL
+    assert mnt.stat("/r2-link")["nlink"] == 1
+
+    # unlink07: open fd survives unlink (orphan list)
+    fd = mnt.open("/orph", O_CREAT | O_RDWR)
+    mnt.write(fd, b"still readable")
+    mnt.unlink("/orph")
+    mnt.lseek(fd, 0)
+    assert mnt.read(fd, 100) == b"still readable"
+    mnt.close(fd)
+
+    # truncate01: shrink + re-extend
+    fd = mnt.open("/t1", O_CREAT | O_WRONLY)
+    mnt.write(fd, b"0123456789")
+    mnt.close(fd)
+    mnt.truncate("/t1", 4)
+    fd = mnt.open("/t1", O_RDONLY)
+    assert mnt.read(fd, 100) == b"0123"
+    mnt.close(fd)
+
+    # append mode
+    fd = mnt.open("/t1", O_WRONLY | O_APPEND)
+    mnt.write(fd, b"XY")
+    mnt.close(fd)
+    fd = mnt.open("/t1", O_RDONLY)
+    assert mnt.read(fd, 100) == b"0123XY"
+    mnt.close(fd)
+
+    # xattr (setfattr/getfattr shape)
+    mnt.setxattr("/t1", "user.tag", b"v1")
+    assert mnt.getxattr("/t1", "user.tag") == b"v1"
+
+    # EBADF discipline
+    fd = mnt.open("/t1", O_RDONLY)
+    mnt.close(fd)
+    with pytest.raises(MountError):
+        mnt.read(fd, 1)
+    mnt.umount()
+
+
+def test_large_file_random_overwrite(cluster):
+    """growfiles analog: interleaved extends + in-place overwrites."""
+    import os as _os
+
+    fs = cluster.fs("posix")
+    blob = _os.urandom(600_000)
+    fs.write_file("/big.bin", blob)
+    expected = bytearray(blob)
+    patch = _os.urandom(10_000)
+    fs.write_at(fs.resolve("/big.bin"), 123_456, patch)
+    expected[123_456:123_456 + len(patch)] = patch
+    assert fs.read_file("/big.bin") == bytes(expected)
+
+
+# -- failover ------------------------------------------------------------------
+
+
+def test_master_failover(cluster):
+    """Kill the master leader; a new leader serves admin + client paths."""
+    mc = cluster.client_master()
+    before = mc.get_cluster()
+    leader_id = before["leader_id"]
+    cluster.kill(f"master{leader_id}")
+
+    deadline = time.time() + 30
+    new_leader = None
+    mc2 = cluster.client_master()
+    while time.time() < deadline:
+        try:
+            mc2.leader_hint = None
+            info = mc2.get_cluster()
+            if info["leader_id"] is not None and info["leader_id"] != leader_id:
+                new_leader = info["leader_id"]
+                break
+        except Exception:
+            pass
+        time.sleep(0.5)
+    assert new_leader is not None, "no new master leader after kill"
+
+    # the surviving quorum serves volume creation + io end-to-end
+    mc2.create_volume("postfail", cold=False)
+    fs = cluster.fs("postfail")
+    fs.write_file("/after-failover.txt", b"quorum survived")
+    assert fs.read_file("/after-failover.txt") == b"quorum survived"
+
+
+def test_metanode_kill_and_replace(cluster):
+    """SIGKILL a metanode; a fresh daemon with the same id + walDir rejoins
+    and the namespace replays (partition_store + self-healing sweep)."""
+    fs = cluster.fs("posix")
+    fs.write_file("/durable.txt", b"survives SIGKILL")
+
+    victim = next(n for n in cluster.procs if n.startswith("metanode"))
+    vid = int(victim.removeprefix("metanode"))
+    cluster.kill(victim)
+    time.sleep(1)
+    cluster.spawn(victim, cluster.metanode_cfg(vid))
+
+    deadline = time.time() + 40
+    while time.time() < deadline:
+        try:
+            if cluster.fs("posix").read_file("/durable.txt") == b"survives SIGKILL":
+                break
+        except Exception:
+            pass
+        time.sleep(0.5)
+    else:
+        raise AssertionError("namespace did not recover after metanode kill")
+
+
+# -- S3 over subprocesses ------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_s3_flow_over_daemons(tmp_path):
+    import http.client
+
+    from chubaofs_tpu.objectnode.auth import sign_v4
+
+    c = ProcCluster(str(tmp_path / "s3"), masters=1, metanodes=3, datanodes=0,
+                    blobstore=True, objectnode=True)
+    try:
+        u = c.client_master().create_user("e2e")
+        ak, sk = u["access_key"], u["secret_key"]
+
+        def req(method, path, body=b"", raw_query=""):
+            target = path + (f"?{raw_query}" if raw_query else "")
+            hdrs = sign_v4(method, path, raw_query, {"host": c.s3_addr},
+                           ak, sk, payload=body)
+            conn = http.client.HTTPConnection(c.s3_addr, timeout=60)
+            try:
+                conn.request(method, target, body=body or None, headers=hdrs)
+                resp = conn.getresponse()
+                return resp.status, resp.read()
+            finally:
+                conn.close()
+
+        status, _ = req("PUT", "/e2ebkt")
+        assert status == 200
+        payload = b"S3 across processes " * 200
+        status, _ = req("PUT", "/e2ebkt/dir/obj.bin", payload)
+        assert status == 200
+        status, body = req("GET", "/e2ebkt/dir/obj.bin")
+        assert status == 200 and body == payload
+        status, body = req("GET", "/e2ebkt", raw_query="list-type=2")
+        assert status == 200 and b"dir/obj.bin" in body
+        status, _ = req("DELETE", "/e2ebkt/dir/obj.bin")
+        assert status in (200, 204)
+        status, body = req("GET", "/e2ebkt/dir/obj.bin")
+        assert status == 404
+    finally:
+        c.close()
