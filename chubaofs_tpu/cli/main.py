@@ -118,6 +118,12 @@ class CLI:
     def datanode_list(self, args):
         self._nodes("data")
 
+    def metanode_decommission(self, args):
+        self._emit(self.mc.decommission_node(args.id, "meta"))
+
+    def datanode_decommission(self, args):
+        self._emit(self.mc.decommission_node(args.id, "data"))
+
     # -- partitions ------------------------------------------------------------
 
     def mp_list(self, args):
@@ -170,7 +176,7 @@ _cfs_cli() {
   case "$prev" in
     cluster) verbs="info" ;;
     vol) verbs="create list info delete" ;;
-    metanode|datanode) verbs="list" ;;
+    metanode|datanode) verbs="list decommission" ;;
     metapartition) verbs="list" ;;
     datapartition) verbs="list create" ;;
     user) verbs="create delete info list perm" ;;
@@ -216,8 +222,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     mn = sub.add_parser("metanode").add_subparsers(dest="verb", required=True)
     mn.add_parser("list").set_defaults(fn="metanode_list")
+    md = mn.add_parser("decommission")
+    md.add_argument("id", type=int)
+    md.set_defaults(fn="metanode_decommission")
     dn = sub.add_parser("datanode").add_subparsers(dest="verb", required=True)
     dn.add_parser("list").set_defaults(fn="datanode_list")
+    dd = dn.add_parser("decommission")
+    dd.add_argument("id", type=int)
+    dd.set_defaults(fn="datanode_decommission")
 
     mp = sub.add_parser("metapartition").add_subparsers(dest="verb", required=True)
     m = mp.add_parser("list")

@@ -133,6 +133,8 @@ class MasterDaemon(_Daemon):
         self.master = Master(self.raft, self.sm)
         self.master.metanode_hook = self._meta_hook
         self.master.datanode_hook = self._data_hook
+        self.master.raft_config_hook = self._raft_config_hook
+        self.master.remove_partition_hook = self._remove_partition_hook
         self.api = MasterAPI(self.master,
                              leader_addr_of=lambda nid: self.peer_apis.get(nid, ""))
         host, port = _addr_split(cfg.get("listen", "127.0.0.1:0"))
@@ -201,6 +203,74 @@ class MasterDaemon(_Daemon):
                     recv_packet(sock)
             except Exception:
                 pass
+
+    def _send_data_packet(self, addr: str, pkt):
+        """One admin packet round-trip to a datanode."""
+        import socket
+
+        from chubaofs_tpu.proto.packet import recv_packet, send_packet
+
+        host, port = _addr_split(addr)
+        with socket.create_connection((host, port), timeout=10) as sock:
+            send_packet(sock, pkt)
+            return recv_packet(sock)
+
+    def _raft_config_hook(self, kind: str, pid: int, action: str,
+                          node_id: int, peers: list[int]) -> None:
+        """Membership change for a decommission: find the partition's raft
+        leader among the current peers and propose there (retrying the
+        not-leader bounce)."""
+        import time
+
+        from chubaofs_tpu.proto.packet import (
+            OP_RAFT_CONFIG, Packet, RES_NOT_LEADER, RES_OK)
+        from chubaofs_tpu.raft.server import NotLeaderError
+
+        raft_addrs = self._raft_addrs(list(set(peers) | {node_id}))
+        deadline = time.time() + 30
+        last = "no peers reachable"
+        while time.time() < deadline:
+            for peer in peers:
+                node = self.sm.nodes.get(peer)
+                if node is None or not node.addr:
+                    continue
+                try:
+                    if kind == "meta":
+                        self._meta_handle(peer, node.addr)._call(
+                            pid, "admin_raft_config", action=action,
+                            node_id=node_id, raft_addrs=raft_addrs)
+                        return
+                    rep = self._send_data_packet(node.addr, Packet(
+                        OP_RAFT_CONFIG, partition_id=pid,
+                        arg={"action": action, "node_id": node_id,
+                             "raft_addrs": raft_addrs}))
+                    if rep.result == RES_OK:
+                        return
+                    if rep.result != RES_NOT_LEADER:
+                        last = rep.error()
+                except NotLeaderError as e:
+                    last = f"not leader (hint {e.leader})"
+                except Exception as e:
+                    last = str(e)
+            time.sleep(0.3)
+        raise RuntimeError(f"raft config {action}({node_id}) on {pid}: {last}")
+
+    def _remove_partition_hook(self, kind: str, pid: int, node_id: int) -> None:
+        from chubaofs_tpu.proto.packet import OP_REMOVE_PARTITION, Packet
+
+        node = self.sm.nodes.get(node_id)
+        if node is None or not node.addr:
+            return  # node gone; nothing to clean
+        try:
+            if kind == "meta":
+                self._meta_handle(node_id, node.addr)._call(
+                    pid, "admin_remove_partition")
+            else:
+                self._send_data_packet(node.addr, Packet(
+                    OP_REMOVE_PARTITION, partition_id=pid))
+        except Exception as e:
+            _log(f"master{self.node_id}",
+                 f"remove {kind} partition {pid} on node {node_id}: {e}")
 
     def _ensure(self):
         """Re-send create tasks to replicas whose heartbeats miss a partition."""

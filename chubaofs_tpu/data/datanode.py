@@ -28,6 +28,7 @@ import threading
 from chubaofs_tpu.data.repl import FollowerAckError, ReplError, ReplServer
 from chubaofs_tpu.proto.packet import (
     OP_CREATE_EXTENT, OP_CREATE_PARTITION, OP_GET_PARTITION_METRICS,
+    OP_RAFT_CONFIG, OP_REMOVE_PARTITION,
     OP_GET_WATERMARKS, OP_HEARTBEAT, OP_MARK_DELETE, OP_RANDOM_WRITE,
     OP_REPAIR_READ, OP_REPAIR_WRITE, OP_STREAM_READ, OP_TINY_DELETE_RECORD,
     OP_WRITE, Packet, RES_DISK_ERR, RES_ERR, RES_NOT_EXIST, RES_NOT_LEADER,
@@ -227,6 +228,36 @@ class DataNode:
                                     self.raft)
         return pkt.reply()
 
+    def _op_raft_config(self, pkt: Packet) -> Packet:
+        """Single-server membership change; only the raft leader proposes."""
+        dp = self._dp(pkt)
+        a = pkt.arg
+        if dp.raft is None:
+            dp.update_membership(a.get("peers", dp.peers),
+                                 a.get("hosts", dp.hosts))
+            return pkt.reply()
+        if not dp.is_raft_leader:
+            return pkt.reply(RES_NOT_LEADER,
+                             arg={"leader": dp.raft.leader_of(dp.pid)})
+        raft_addrs = a.get("raft_addrs") or {}
+        if hasattr(dp.raft.net, "set_peer"):
+            for nid, addr in raft_addrs.items():
+                dp.raft.net.set_peer(int(nid), addr)
+        peers = dp.raft.propose_config(dp.pid, a["action"], a["node_id"]).result(10)
+        dp.update_membership(a.get("peers", dp.peers), a.get("hosts", dp.hosts))
+        return pkt.reply(arg={"peers": peers})
+
+    def _op_remove_partition(self, pkt: Packet) -> Packet:
+        """Drop a retired replica: leave the raft group, retire the dir."""
+        import shutil
+
+        dp = self.space.partitions.pop(pkt.partition_id, None)
+        if dp is not None:
+            if self.raft is not None:
+                self.raft.remove_group(dp.pid)
+            shutil.rmtree(dp.root, ignore_errors=True)
+        return pkt.reply()
+
     def _op_heartbeat(self, pkt: Packet) -> Packet:
         return pkt.reply(arg={"node_id": self.node_id,
                               "partitions": len(self.space.partitions)})
@@ -348,6 +379,8 @@ class DataNode:
         OP_MARK_DELETE: _op_mark_delete,
         OP_RANDOM_WRITE: _op_random_write,
         OP_TINY_DELETE_RECORD: _op_tiny_delete_record,
+        OP_RAFT_CONFIG: _op_raft_config,
+        OP_REMOVE_PARTITION: _op_remove_partition,
         OP_STREAM_READ: _op_stream_read,
         OP_REPAIR_READ: _op_stream_read,
         OP_GET_WATERMARKS: _op_get_watermarks,
@@ -364,11 +397,16 @@ class DataNode:
         views: dict[str, dict] = {}
         for host in dp.hosts:
             if host == self.addr:
-                views[host] = self._op_get_watermarks(
-                    Packet(OP_GET_WATERMARKS, partition_id=pid)).arg
+                rep = self._op_get_watermarks(
+                    Packet(OP_GET_WATERMARKS, partition_id=pid))
             else:
-                views[host] = self.server.request(
-                    host, Packet(OP_GET_WATERMARKS, partition_id=pid)).arg
+                try:
+                    rep = self.server.request(
+                        host, Packet(OP_GET_WATERMARKS, partition_id=pid))
+                except (OSError, ReplError):
+                    continue  # dead replica: repair the reachable set
+            if rep.result == RES_OK:
+                views[host] = rep.arg
 
         # union of deletes wins: an extent deleted anywhere dies everywhere
         deleted = set()

@@ -78,6 +78,8 @@ class FsCluster:
         for i, m in self.masters.items():
             m.metanode_hook = self._create_meta_partition
             m.datanode_hook = self._create_data_partition
+            m.raft_config_hook = self._raft_config
+            m.remove_partition_hook = self._remove_partition
 
         for j in range(1, data_nodes + 1):
             nid = DATANODE_ID_BASE + j
@@ -167,6 +169,51 @@ class FsCluster:
                 return node
         raise MasterError("no authnode leader")
 
+    def _raft_config(self, kind: str, pid: int, action: str, node_id: int,
+                     peers: list[int]) -> None:
+        """Propose a membership change on the partition's raft leader and
+        pump ticks until it commits (decommission hook). The proposal is
+        async — blocking on the future while also being the tick pump would
+        deadlock the in-proc cluster."""
+        del kind, peers  # in-proc: every group lives on self.rafts
+        fut = None
+
+        def try_once():
+            nonlocal fut
+            if fut is not None and fut.done():
+                return True
+            if fut is None or (fut.done() and fut.exception()):
+                for raft in self.rafts.values():
+                    if pid in raft.groups and raft.is_leader(pid):
+                        try:
+                            fut = raft.propose_config(pid, action, node_id)
+                        except NotLeaderError:
+                            fut = None
+                        break
+            return fut is not None and fut.done() and fut.exception() is None
+
+        assert self.settle(try_once, max_ticks=1200), \
+            f"membership change {action}({node_id}) on {pid} did not commit"
+
+    def _remove_partition(self, kind: str, pid: int, node_id: int) -> None:
+        from chubaofs_tpu.proto.packet import OP_REMOVE_PARTITION
+
+        if kind == "meta":
+            mn = self.metanodes.get(node_id)
+            if mn is not None:
+                mn.remove_partition(pid)
+            return
+        node = self.master().sm.nodes.get(node_id)
+        dn = self._datanode_at(node.addr) if node else None
+        if dn is None:
+            return
+        sock = self.admin_pool.get(dn.addr)
+        try:
+            send_packet(sock, Packet(OP_REMOVE_PARTITION, partition_id=pid))
+            recv_packet(sock)
+        finally:
+            self.admin_pool.put(dn.addr, sock)
+
     def _resolve_tx(self, tm_pid: int, tx_id: str) -> str:
         """Participant-sweep hook: ask the TM partition's leader for the
         txn decision (metanode tx RM->TM status query analog)."""
@@ -178,15 +225,23 @@ class FsCluster:
     def _datanode_at(self, addr: str) -> DataNode | None:
         return next((d for d in self.datanodes.values() if d.addr == addr), None)
 
-    def _create_meta_partition(self, pid: int, start: int, end: int, peers: list[int]):
+    def _create_meta_partition(self, pid: int, start: int, end: int,
+                               peers: list[int], only: int | None = None):
         for peer in peers:
-            self.metanodes[peer].create_partition(pid, start, end, peers)
-        self.settle(lambda: any(self.rafts[p].is_leader(pid) for p in peers))
+            if only is not None and peer != only:
+                continue
+            if pid not in self.metanodes[peer].partitions:
+                self.metanodes[peer].create_partition(pid, start, end, peers)
+        if only is None:
+            self.settle(lambda: any(self.rafts[p].is_leader(pid) for p in peers))
 
-    def _create_data_partition(self, pid: int, peers: list[int], hosts: list[str]):
+    def _create_data_partition(self, pid: int, peers: list[int],
+                               hosts: list[str], only: int | None = None):
         """Admin task to every replica host (master/cluster_task.go analog),
         over the real wire."""
-        for addr in hosts:
+        for peer, addr in zip(peers, hosts):
+            if only is not None and peer != only:
+                continue
             sock = self.admin_pool.get(addr)
             try:
                 send_packet(sock, Packet(OP_CREATE_PARTITION, partition_id=pid,
@@ -198,7 +253,8 @@ class FsCluster:
             self.admin_pool.put(addr, sock)
             if rep.result != RES_OK:
                 raise MasterError(f"create dp {pid} on {addr}: {rep.error()}")
-        self.settle(lambda: any(self.rafts[p].is_leader(pid) for p in peers))
+        if only is None:
+            self.settle(lambda: any(self.rafts[p].is_leader(pid) for p in peers))
 
     def _purge_client(self) -> ExtentClient:
         """One ExtentClient over every volume's partition table (purge path)."""

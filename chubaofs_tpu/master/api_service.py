@@ -56,6 +56,8 @@ class MasterAPI:
         g("/dataNode/add", self._w(self.add_node_data))
         g("/metaNode/add", self._w(self.add_node_meta))
         g("/node/heartbeat", self._w(self.node_heartbeat))
+        g("/dataNode/decommission", self._w(self.decommission_data))
+        g("/metaNode/decommission", self._w(self.decommission_meta))
         g("/user/create", self._w(self.user_create))
         g("/user/delete", self._w(self.user_delete))
         g("/user/info", self._w(self.user_info, leader=False))
@@ -170,6 +172,12 @@ class MasterAPI:
                               cursors=cursors)
         return None
 
+    def decommission_meta(self, req: Request):
+        return {"migrated": self.master.decommission_metanode(int(req.q("id")))}
+
+    def decommission_data(self, req: Request):
+        return {"migrated": self.master.decommission_datanode(int(req.q("id")))}
+
     def user_create(self, req: Request):
         u = self.master.create_user(req.q("user"), req.q("type", "normal"))
         return asdict(u)
@@ -273,6 +281,10 @@ class MasterClient:
 
     def create_data_partition(self, name: str):
         return self.call(self._path("/admin/createDataPartition", name=name))
+
+    def decommission_node(self, node_id: int, kind: str):
+        which = "dataNode" if kind == "data" else "metaNode"
+        return self.call(self._path(f"/{which}/decommission", id=node_id))
 
     def meta_partitions(self, name: str):
         return self.call(self._path("/client/metaPartitions", name=name))

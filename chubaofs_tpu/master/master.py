@@ -39,6 +39,11 @@ class NodeInfo:
     last_heartbeat: float = 0.0
     partition_count: int = 0
     cursors: dict[int, int] = field(default_factory=dict)  # pid -> cursor (meta)
+    status: str = "active"  # active | decommissioned
+
+    @property
+    def schedulable(self) -> bool:
+        return getattr(self, "status", "active") == "active"
 
 
 @dataclass
@@ -236,6 +241,38 @@ class MasterSM(StateMachine):
             u.authorized_vols.pop(name, None)
         return vol
 
+    # -- decommission bookkeeping (master decommission APIs) -------------------
+
+    def _op_set_node_status(self, node_id: int, status: str):
+        n = self.nodes.get(node_id)
+        if n is None:
+            raise MasterError(f"unknown node {node_id}")
+        n.status = status
+        return None
+
+    def _op_update_mp_peers(self, vol_name: str, partition_id: int,
+                            peers: list[int]):
+        vol = self.volumes.get(vol_name)
+        if vol is None:
+            raise MasterError(f"unknown volume {vol_name!r}")
+        for mp in vol.meta_partitions:
+            if mp.partition_id == partition_id:
+                mp.peers = list(peers)
+                return None
+        raise MasterError(f"unknown partition {partition_id}")
+
+    def _op_update_dp_members(self, vol_name: str, partition_id: int,
+                              peers: list[int], hosts: list[str]):
+        vol = self.volumes.get(vol_name)
+        if vol is None:
+            raise MasterError(f"unknown volume {vol_name!r}")
+        for dp in vol.data_partitions:
+            if dp.partition_id == partition_id:
+                dp.peers = list(peers)
+                dp.hosts = list(hosts)
+                return None
+        raise MasterError(f"unknown data partition {partition_id}")
+
     # -- user store (master/user.go analog) -----------------------------------
 
     def _op_create_user(self, user_id: str, access_key: str, secret_key: str,
@@ -294,6 +331,13 @@ class Master:
         self.sm = sm
         self.metanode_hook = None  # (pid, start, end, peers) -> None
         self.datanode_hook = None  # (pid, peers, hosts) -> None
+        # decommission plumbing (deployment-wired, like the create hooks):
+        # raft_config_hook(kind, pid, action, node_id, peers) proposes a
+        # membership change on the partition's raft leader;
+        # remove_partition_hook(kind, pid, node_id) drops the group+state on
+        # the retired replica
+        self.raft_config_hook = None
+        self.remove_partition_hook = None
 
     def _apply(self, op: str, **args):
         res = self.raft.propose(MASTER_GROUP, (op, args)).result(timeout=5)
@@ -318,18 +362,20 @@ class Master:
 
     # -- volume admin -----------------------------------------------------------
 
-    def _pick_meta_peers(self, count: int = 3) -> list[int]:
+    def _pick_meta_peers(self, count: int = 3, exclude: set[int] = frozenset()) -> list[int]:
         metas = sorted(
-            (n for n in self.sm.nodes.values() if n.kind == "meta"),
+            (n for n in self.sm.nodes.values()
+             if n.kind == "meta" and n.schedulable and n.node_id not in exclude),
             key=lambda n: n.partition_count,
         )
         if len(metas) < count:
             raise MasterError(f"need {count} metanodes, have {len(metas)}")
         return [n.node_id for n in metas[:count]]
 
-    def _pick_data_peers(self, count: int = 3) -> list[NodeInfo]:
+    def _pick_data_peers(self, count: int = 3, exclude: set[int] = frozenset()) -> list[NodeInfo]:
         datas = sorted(
-            (n for n in self.sm.nodes.values() if n.kind == "data"),
+            (n for n in self.sm.nodes.values()
+             if n.kind == "data" and n.schedulable and n.node_id not in exclude),
             key=lambda n: n.partition_count,
         )
         if len(datas) < count:
@@ -442,6 +488,77 @@ class Master:
 
     def set_vol_owner(self, user_id: str, vol_name: str, add: bool = True) -> None:
         self._apply("user_own_vol", user_id=user_id, vol_name=vol_name, add=add)
+
+    # -- decommission (master decommission APIs + migrate orchestration) -------
+    #
+    # The reference drains a node by re-homing every partition replica it
+    # hosts (master decommission flows in cluster.go/vol.go). Per partition
+    # the safe single-server dance is: create the group on the replacement
+    # (it catches up via raft snapshot/appends) -> propose add(replacement)
+    # -> propose remove(victim) -> drop state on the victim -> record the new
+    # membership. Chain data (hot extents) back-fills through the extent
+    # repair sweep once the replacement is in the hosts list.
+
+    def decommission_metanode(self, node_id: int) -> int:
+        if self.sm.nodes.get(node_id) is None:
+            raise MasterError(f"unknown node {node_id}")
+        self._apply("set_node_status", node_id=node_id, status="decommissioned")
+        moved = 0
+        for vol in list(self.sm.volumes.values()):
+            for mp in vol.meta_partitions:
+                if node_id not in mp.peers:
+                    continue
+                repl = self._pick_meta_peers(1, exclude=set(mp.peers))[0]
+                new_peers = [p for p in mp.peers if p != node_id] + [repl]
+                if self.metanode_hook:
+                    # replacement-only create with the final membership
+                    self.metanode_hook(mp.partition_id, mp.start, mp.end,
+                                       new_peers, only=repl)
+                if self.raft_config_hook:
+                    self.raft_config_hook("meta", mp.partition_id, "add",
+                                          repl, mp.peers)
+                    self.raft_config_hook("meta", mp.partition_id, "remove",
+                                          node_id, new_peers)
+                if self.remove_partition_hook:
+                    self.remove_partition_hook("meta", mp.partition_id, node_id)
+                self._apply("update_mp_peers", vol_name=vol.name,
+                            partition_id=mp.partition_id, peers=new_peers)
+                moved += 1
+        return moved
+
+    def decommission_datanode(self, node_id: int) -> int:
+        if self.sm.nodes.get(node_id) is None:
+            raise MasterError(f"unknown node {node_id}")
+        self._apply("set_node_status", node_id=node_id, status="decommissioned")
+        moved = 0
+        for vol in list(self.sm.volumes.values()):
+            for dp in vol.data_partitions:
+                if node_id not in dp.peers:
+                    continue
+                repl = self._pick_data_peers(1, exclude=set(dp.peers))[0]
+                idx = dp.peers.index(node_id)
+                new_peers = [p for p in dp.peers if p != node_id] + [repl.node_id]
+                hosts = self._current_hosts(dp.peers, dp.hosts)
+                new_hosts = [h for i, h in enumerate(hosts) if i != idx] + [repl.addr]
+                if self.datanode_hook:
+                    self.datanode_hook(dp.partition_id, new_peers, new_hosts,
+                                       only=repl.node_id)
+                if self.raft_config_hook:
+                    self.raft_config_hook("data", dp.partition_id, "add",
+                                          repl.node_id, dp.peers)
+                    self.raft_config_hook("data", dp.partition_id, "remove",
+                                          node_id, new_peers)
+                if self.remove_partition_hook:
+                    self.remove_partition_hook("data", dp.partition_id, node_id)
+                self._apply("update_dp_members", vol_name=vol.name,
+                            partition_id=dp.partition_id, peers=new_peers,
+                            hosts=new_hosts)
+                if self.datanode_hook:
+                    # idempotent re-send refreshes peers/hosts on survivors
+                    # (their local meta still lists the victim)
+                    self.datanode_hook(dp.partition_id, new_peers, new_hosts)
+                moved += 1
+        return moved
 
     # -- background checks (scheduleTask loop analogs) --------------------------
 

@@ -45,6 +45,20 @@ class MetaNode:
     def is_leader(self, partition_id: int) -> bool:
         return self.raft.is_leader(partition_id)
 
+    def remove_partition(self, partition_id: int) -> None:
+        """Drop a retired replica (decommission tail step)."""
+        with self._lock:
+            self.raft.remove_group(partition_id)
+            self.partitions.pop(partition_id, None)
+
+    def propose_raft_config(self, partition_id: int, action: str,
+                            node_id: int, timeout: float = 10.0):
+        """Single-server membership change; must run on the group leader."""
+        if partition_id not in self.partitions:
+            raise OpError("ENOPARTITION",
+                          f"partition {partition_id} not on node {self.node_id}")
+        return self.raft.propose_config(partition_id, action, node_id).result(timeout)
+
     # -- write ops: through raft ---------------------------------------------
 
     def submit(self, partition_id: int, op: str, **args) -> Future:

@@ -138,6 +138,18 @@ class MetaService:
                 if pid not in self.metanode.partitions:
                     self.metanode.create_partition(pid, **args)
                 return pkt.reply(RES_OK, data=b"null")
+            if op == "admin_remove_partition":
+                self.metanode.remove_partition(pid)
+                return pkt.reply(RES_OK, data=b"null")
+            if op == "admin_raft_config":
+                # the leader must be able to dial a freshly added member
+                raft_addrs = args.get("raft_addrs") or {}
+                if hasattr(self.metanode.raft.net, "set_peer"):
+                    for nid, addr in raft_addrs.items():
+                        self.metanode.raft.net.set_peer(int(nid), addr)
+                out = self.metanode.propose_raft_config(
+                    pid, args["action"], args["node_id"])
+                return pkt.reply(RES_OK, data=json.dumps(enc(out)).encode())
             if op == "admin_partitions":
                 out = sorted(self.metanode.partitions)
                 return pkt.reply(RES_OK, data=json.dumps(out).encode())

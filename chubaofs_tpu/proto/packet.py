@@ -34,6 +34,8 @@ OP_GET_PARTITION_METRICS = 0x09  # used + extent counts, for master heartbeats
 OP_HEARTBEAT = 0x0A  # liveness probe
 OP_CREATE_PARTITION = 0x0B  # admin: host a new data partition
 OP_TINY_DELETE_RECORD = 0x0C  # replicated tiny-range punch-hole record
+OP_RAFT_CONFIG = 0x0D  # admin: single-server raft membership change
+OP_REMOVE_PARTITION = 0x0E  # admin: drop a retired partition replica
 # metadata plane (proto/packet.go:72-82 OpMeta* analog): one opcode, the op
 # name rides the arg blob — the metanode partition SM already dispatches by
 # name, so ~40 distinct OpMeta opcodes collapse to a tagged envelope

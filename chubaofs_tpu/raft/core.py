@@ -140,6 +140,30 @@ class RaftCore:
             self._broadcast_append()
         return index
 
+    # -- membership (single-server change: one add/remove per entry keeps any
+    # two quorums overlapping, the standard safe reconfiguration) -------------
+
+    def apply_config(self, action: str, node_id: int) -> None:
+        """Run when a __config_change__ entry COMMITS, on every replica."""
+        if action == "add" and node_id != self.id and node_id not in self.peers:
+            self.peers.append(node_id)
+            if self.role == ROLE_LEADER:
+                self.next_index[node_id] = self.last_index + 1
+                self.match_index[node_id] = 0
+        elif action == "remove":
+            if node_id == self.id:
+                # removed from the group: stop campaigning/serving
+                self.peers = []
+                self.role = ROLE_FOLLOWER
+                self.leader = None
+                return
+            if node_id in self.peers:
+                self.peers.remove(node_id)
+                self.next_index.pop(node_id, None)
+                self.match_index.pop(node_id, None)
+                if self.role == ROLE_LEADER:
+                    self._advance_commit()  # quorum may shrink past pending
+
     def step(self, m: Msg):
         if m.term > self.term:
             self._become_follower(m.term, m.src if m.type == "append" else None)

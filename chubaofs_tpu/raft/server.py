@@ -102,6 +102,8 @@ class _Group:
             self.core.offset = meta["index"]
             self.core.offset_term = meta["term"]
             self.core.commit = self.core.applied = meta["index"]
+            if "peers" in meta:
+                self.core.peers = [p for p in meta["peers"] if p != self.core.id]
         if os.path.exists(self.wal_path):
             with open(self.wal_path) as f:
                 for line in f:
@@ -121,11 +123,17 @@ class _Group:
                     elif rec[0] == "commit":
                         idx = min(rec[1], self.core.last_index)
                         self.core.commit = max(self.core.commit, idx)
-            # replay committed entries into the SM
+            # replay committed entries into the SM (config changes re-apply to
+            # the core so the recovered membership matches pre-crash)
             for idx in range(self.core.offset + 1, self.core.commit + 1):
                 ent = self.core.entry_at(idx)
-                if ent.data is not None:
-                    self.sm.apply(ent.data, idx)
+                if ent.data is None:
+                    continue
+                if (isinstance(ent.data, tuple) and len(ent.data) == 3
+                        and ent.data[0] == "__config_change__"):
+                    self.core.apply_config(ent.data[1], ent.data[2])
+                    continue
+                self.sm.apply(ent.data, idx)
             self.core.applied = self.core.commit
 
     def persist(self, hard_state_changed: bool, new_entries: list[tuple[int, Entry]], commit: int):
@@ -147,7 +155,10 @@ class _Group:
         idx = self.core.applied
         term = self.core.term_at(idx)
         payload = self.sm.snapshot()
-        meta = json.dumps({"index": idx, "term": term}).encode()
+        # membership travels with the snapshot: config entries before the
+        # compaction point are gone from the log
+        meta = json.dumps({"index": idx, "term": term,
+                           "peers": list(self.core.peers)}).encode()
         tmp = self.wal_path + ".snap.tmp"
         with open(tmp, "wb") as f:
             f.write(len(meta).to_bytes(4, "little") + meta + payload)
@@ -253,6 +264,13 @@ class MultiRaft:
             if isinstance(ent.data, tuple) and len(ent.data) == 2 and ent.data[0] == "__install_snapshot__":
                 g.sm.restore(ent.data[1])
                 continue
+            if (isinstance(ent.data, tuple) and len(ent.data) == 3
+                    and ent.data[0] == "__config_change__"):
+                g.core.apply_config(ent.data[1], ent.data[2])
+                waiter = g.waiters.pop(idx, None)
+                if waiter and ent.term == waiter[0]:
+                    waiter[1].set_result(sorted(g.core.peers + [g.core.id]))
+                continue
             result = g.sm.apply(ent.data, idx) if ent.data is not None else None
             waiter = g.waiters.pop(idx, None)
             if waiter:
@@ -272,6 +290,12 @@ class MultiRaft:
         return msgs
 
     # -- client API ------------------------------------------------------------
+
+    def propose_config(self, group_id: int, action: str, node_id: int) -> Future:
+        """Single-server membership change ('add'/'remove' one node); the
+        future resolves with the new peer set once the change commits."""
+        assert action in ("add", "remove"), action
+        return self.propose(group_id, ("__config_change__", action, node_id))
 
     def propose(self, group_id: int, data) -> Future:
         """Replicate one command; future resolves with sm.apply's result."""
