@@ -74,6 +74,7 @@ def main() -> None:
     # reconstruct the blobnode-repair way: survivors in, missing rows out
     # (1 missing data shard; target 25 GB/s)
     mat_bits, present, _ = kernel.repair_plan([0])
+    mat_bits = jax.device_put(jnp.asarray(mat_bits), dev)  # repair plans are numpy; pin on-device before timing
     stripe = jax.jit(kernel.encode)(data)
     survivors = jax.jit(lambda s: jnp.take(s, present, axis=-2))(stripe)
     survivors.block_until_ready()

@@ -105,9 +105,11 @@ class RSKernel:
         self.m = m
         self.total = n + m
         self.gen = gf256.systematic_generator(n, m)  # (n+m, n) uint8
-        self.parity_bits = jnp.asarray(
-            bitmatrix.expand_matrix(self.gen[n:, :]).astype(np.int8)
-        )
+        # numpy, NOT jnp: committing to the default device here would break
+        # sharded/CPU call sites (the multi-chip dryrun must never touch the
+        # default backend); inside jit a numpy constant is embedded and placed
+        # by XLA wherever the computation runs.
+        self.parity_bits = bitmatrix.expand_matrix(self.gen[n:, :]).astype(np.int8)
 
     # -- encode ------------------------------------------------------------
     #
@@ -149,14 +151,15 @@ class RSKernel:
         return mat, present, missing
 
     def repair_plan(self, bad_idx: list[int], data_only: bool = False):
-        """Device-ready repair plan: (repair_bits, present, missing) jnp arrays.
+        """Device-ready repair plan: (repair_bits, present, missing) numpy arrays.
 
         Shared by reconstruct, the sharded codec step, and the benches so the
-        bit-matrix repair lowering lives in exactly one place.
+        bit-matrix repair lowering lives in exactly one place. Kept as numpy so
+        closing over a plan inside jit never commits to the default device.
         """
         mat, present, missing = self.repair_matrix(bad_idx, data_only)
-        mat_bits = jnp.asarray(bitmatrix.expand_matrix(mat).astype(np.int8))
-        return mat_bits, jnp.asarray(present), jnp.asarray(missing)
+        mat_bits = bitmatrix.expand_matrix(mat).astype(np.int8)
+        return mat_bits, np.asarray(present, np.int32), np.asarray(missing, np.int32)
 
     def apply_repair(self, plan, shards: jax.Array, *, portable: bool = False) -> jax.Array:
         """Apply a repair_plan to (..., n+m, k) shards (jit-friendly)."""

@@ -19,7 +19,6 @@ The bit-generator matrices are tiny (<= 320x320 int8) and replicated.
 from __future__ import annotations
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -43,8 +42,14 @@ def codec_mesh(devices=None, dp: int | None = None, sp: int | None = None) -> Me
     return Mesh(arr, axis_names=("dp", "sp"))
 
 
-def shard_stripes(mesh: Mesh, stripes: jax.Array) -> jax.Array:
-    """Place (B, n, k) stripes: B over dp, k over sp, shard axis replicated."""
+def shard_stripes(mesh: Mesh, stripes) -> jax.Array:
+    """Place (B, n, k) stripes: B over dp, k over sp, shard axis replicated.
+
+    Host data goes straight to the mesh's devices — no intermediate commit to
+    the default backend (which may be a different platform than the mesh).
+    """
+    if not isinstance(stripes, jax.Array):
+        stripes = np.asarray(stripes)
     return jax.device_put(stripes, NamedSharding(mesh, P("dp", None, "sp")))
 
 
@@ -73,7 +78,7 @@ def sharded_codec_step(mesh: Mesh, n: int, m: int):
     jitted = jax.jit(step, out_shardings=(out_spec, ok_spec, out_spec))
 
     def run(data):
-        data = shard_stripes(mesh, jnp.asarray(data))
+        data = shard_stripes(mesh, data)
         with mesh:
             return jitted(data)
 
