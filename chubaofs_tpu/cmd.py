@@ -63,8 +63,8 @@ def _log(daemon: str, msg: str) -> None:
 
 def _make_net(node_id: int, peers: dict[int, str], cfg: dict) -> TcpNet:
     """TcpNet with the cluster secret from config. Deployments binding raft
-    off-loopback MUST set `raftSecret`: frames are pickled, and the HMAC gate
-    is only as strong as the secret."""
+    off-loopback MUST set `raftSecret` (TcpNet refuses the well-known default
+    off-loopback); frames decode through the safe raft.codec either way."""
     secret = cfg.get("raftSecret")
     if secret:
         return TcpNet(node_id, peers, secret=secret.encode())
@@ -218,19 +218,29 @@ class MasterDaemon(_Daemon):
     def _raft_config_hook(self, kind: str, pid: int, action: str,
                           node_id: int, peers: list[int]) -> None:
         """Membership change for a decommission: find the partition's raft
-        leader among the current peers and propose there (retrying the
-        not-leader bounce)."""
+        leader among the candidate peers and propose there, FOLLOWING the
+        not-leader hint. The candidate list must include every node that can
+        currently be leader — for a remove that includes the node being
+        removed (a raft leader may propose its own removal and step down on
+        apply; the reference's removeMetaPartitionRaftMember does the same
+        leader-first dance)."""
         import time
 
         from chubaofs_tpu.proto.packet import (
             OP_RAFT_CONFIG, Packet, RES_NOT_LEADER, RES_OK)
         from chubaofs_tpu.raft.server import NotLeaderError
 
+        candidates = list(dict.fromkeys(peers))
         raft_addrs = self._raft_addrs(list(set(peers) | {node_id}))
-        deadline = time.time() + 30
+        deadline = time.time() + 20
         last = "no peers reachable"
+
+        def note_hint(hint):
+            if isinstance(hint, int) and hint not in candidates:
+                candidates.append(hint)
+
         while time.time() < deadline:
-            for peer in peers:
+            for peer in list(candidates):
                 node = self.sm.nodes.get(peer)
                 if node is None or not node.addr:
                     continue
@@ -246,9 +256,13 @@ class MasterDaemon(_Daemon):
                              "raft_addrs": raft_addrs}))
                     if rep.result == RES_OK:
                         return
-                    if rep.result != RES_NOT_LEADER:
+                    if rep.result == RES_NOT_LEADER:
+                        note_hint(rep.arg.get("leader"))
+                        last = f"not leader (hint {rep.arg.get('leader')})"
+                    else:
                         last = rep.error()
                 except NotLeaderError as e:
+                    note_hint(e.leader)
                     last = f"not leader (hint {e.leader})"
                 except Exception as e:
                     last = str(e)

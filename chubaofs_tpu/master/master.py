@@ -111,21 +111,55 @@ class MasterSM(StateMachine):
             return ("err", str(e))
 
     def snapshot(self) -> bytes:
-        import pickle
+        """Sectioned CRC-framed snapshot (raft.snapcodec) — the reference
+        streams master state as typed RocksDB records (metadata_fsm), never
+        as one opaque language-native blob."""
+        from dataclasses import asdict
 
-        return pickle.dumps(
-            (self.nodes, self.volumes, self.next_id, self.users, self.ak_index))
+        from chubaofs_tpu.raft import snapcodec
+
+        w = snapcodec.SnapshotWriter()
+        w.add("meta", {"next_id": self.next_id})
+        w.add_batched("nodes", (asdict(n) for n in self.nodes.values()))
+        w.add_batched("volumes", (asdict(v) for v in self.volumes.values()))
+        w.add_batched("users", (asdict(u) for u in self.users.values()))
+        return w.getvalue()
 
     def restore(self, payload: bytes) -> None:
-        import pickle
+        from chubaofs_tpu.raft import snapcodec
 
-        state = pickle.loads(payload)
-        if len(state) == 3:  # pre-user snapshot format
-            self.nodes, self.volumes, self.next_id = state
-            self.users, self.ak_index = {}, {}
-        else:
-            (self.nodes, self.volumes, self.next_id,
-             self.users, self.ak_index) = state
+        self.nodes, self.volumes, self.users, self.ak_index = {}, {}, {}, {}
+
+        def load_nodes(batch):
+            for d in batch:
+                d["cursors"] = {int(k): v for k, v in d["cursors"].items()}
+                n = NodeInfo(**d)
+                self.nodes[n.node_id] = n
+
+        def load_volumes(batch):
+            for d in batch:
+                v = VolumeView(
+                    name=d["name"], vol_id=d["vol_id"], owner=d["owner"],
+                    capacity=d["capacity"], cold=d["cold"],
+                    meta_partitions=[MetaPartitionView(**m)
+                                     for m in d["meta_partitions"]],
+                    data_partitions=[DataPartitionView(**p)
+                                     for p in d["data_partitions"]],
+                )
+                self.volumes[v.name] = v
+
+        def load_users(batch):
+            for d in batch:
+                u = UserInfo(**d)
+                self.users[u.user_id] = u
+                self.ak_index[u.access_key] = u.user_id
+
+        snapcodec.restore_sections(payload, {
+            "meta": lambda m: setattr(self, "next_id", m["next_id"]),
+            "nodes": load_nodes,
+            "volumes": load_volumes,
+            "users": load_users,
+        })
 
     # ops ---------------------------------------------------------------------
 
@@ -327,8 +361,13 @@ class Master:
     """
 
     def __init__(self, raft: MultiRaft, sm: MasterSM):
+        import threading
+
         self.raft = raft
         self.sm = sm
+        # one migration at a time: an HTTP client retrying a slow decommission
+        # must not start a second concurrent membership-change dance
+        self._decomm_lock = threading.Lock()
         self.metanode_hook = None  # (pid, start, end, peers) -> None
         self.datanode_hook = None  # (pid, peers, hosts) -> None
         # decommission plumbing (deployment-wired, like the create hooks):
@@ -503,6 +542,10 @@ class Master:
         if self.sm.nodes.get(node_id) is None:
             raise MasterError(f"unknown node {node_id}")
         self._apply("set_node_status", node_id=node_id, status="decommissioned")
+        with self._decomm_lock:
+            return self._migrate_metanode(node_id)
+
+    def _migrate_metanode(self, node_id: int) -> int:
         moved = 0
         for vol in list(self.sm.volumes.values()):
             for mp in vol.meta_partitions:
@@ -517,8 +560,11 @@ class Master:
                 if self.raft_config_hook:
                     self.raft_config_hook("meta", mp.partition_id, "add",
                                           repl, mp.peers)
+                    # contact set for the remove must still include the victim:
+                    # it is often the group's raft leader and must propose its
+                    # own removal (then step down on apply)
                     self.raft_config_hook("meta", mp.partition_id, "remove",
-                                          node_id, new_peers)
+                                          node_id, mp.peers + [repl])
                 if self.remove_partition_hook:
                     self.remove_partition_hook("meta", mp.partition_id, node_id)
                 self._apply("update_mp_peers", vol_name=vol.name,
@@ -530,6 +576,10 @@ class Master:
         if self.sm.nodes.get(node_id) is None:
             raise MasterError(f"unknown node {node_id}")
         self._apply("set_node_status", node_id=node_id, status="decommissioned")
+        with self._decomm_lock:
+            return self._migrate_datanode(node_id)
+
+    def _migrate_datanode(self, node_id: int) -> int:
         moved = 0
         for vol in list(self.sm.volumes.values()):
             for dp in vol.data_partitions:
@@ -546,8 +596,9 @@ class Master:
                 if self.raft_config_hook:
                     self.raft_config_hook("data", dp.partition_id, "add",
                                           repl.node_id, dp.peers)
+                    # include the victim in the contact set (see metanode path)
                     self.raft_config_hook("data", dp.partition_id, "remove",
-                                          node_id, new_peers)
+                                          node_id, dp.peers + [repl.node_id])
                 if self.remove_partition_hook:
                     self.remove_partition_hook("data", dp.partition_id, node_id)
                 self._apply("update_dp_members", vol_name=vol.name,

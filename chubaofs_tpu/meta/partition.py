@@ -5,9 +5,10 @@ start/end inode range), inode.go:57-75 (Inode with Extents + ObjExtents),
 dentry.go:42-47, the fsm ops in partition_fsmop_inode.go and the snapshot logic
 of partition_store.go. Differences by design: the store is plain dicts behind a
 raft StateMachine (ops arrive ordered and single-threaded, so btree clones and
-copy-on-write are unnecessary); snapshots are whole-state pickles through the
-raft server's snapshot hook; the orphan freelist is a queue drained by the
-metanode's delete loop (partition_free_list.go analog).
+copy-on-write are unnecessary); snapshots are sectioned CRC-framed binary
+streams (raft.snapcodec — the partition_store.go per-type-files-with-CRCs
+analog) applied batch-by-batch on restore; the orphan freelist is a queue
+drained by the metanode's delete loop (partition_free_list.go analog).
 
 Every mutating verb is a pure (op, args) command applied through raft; reads go
 through the leader's local state.
@@ -15,11 +16,11 @@ through the leader's local state.
 
 from __future__ import annotations
 
-import pickle
 import stat as stat_mod
 import time
 from dataclasses import dataclass, field
 
+from chubaofs_tpu.raft import snapcodec
 from chubaofs_tpu.raft.server import StateMachine
 
 ROOT_INO = 1
@@ -172,47 +173,90 @@ class MetaPartitionSM(StateMachine):
                     del self.uniq_seen[k]
         return result
 
+    # Snapshots: sectioned CRC-framed stream (partition_store.go per-type
+    # files analog). Inodes/dentries/orphans are REPEATED bounded-size
+    # sections so a lagging follower applies a large namespace incrementally
+    # instead of decoding one giant object.
+
+    @staticmethod
+    def _inode_wire(i: Inode) -> list:
+        return [i.ino, i.mode, i.uid, i.gid, i.size, i.nlink, i.ctime, i.mtime,
+                [[e.file_offset, e.size, e.partition_id, e.extent_id,
+                  e.extent_offset] for e in i.extents],
+                i.obj_extents, i.xattrs]
+
+    @staticmethod
+    def _inode_unwire(w: list) -> Inode:
+        return Inode(ino=w[0], mode=w[1], uid=w[2], gid=w[3], size=w[4],
+                     nlink=w[5], ctime=w[6], mtime=w[7],
+                     extents=[ExtentKey(*e) for e in w[8]],
+                     obj_extents=list(w[9]), xattrs=dict(w[10]))
+
     def snapshot(self) -> bytes:
-        return pickle.dumps(
-            {
-                "partition_id": self.partition_id,
-                "start": self.start,
-                "end": self.end,
-                "cursor": self.cursor,
-                "inodes": self.inodes,
-                "dentries": self.dentries,
-                "freelist": self.freelist,
-                "orphans": self.orphans,
-                "del_extents": self.del_extents,
-                "del_seq": self.del_seq,
-                "multipart": self.multipart,
-                "uniq_seen": self.uniq_seen,
-                "txns": self.txns,
-                "tx_locks": self.tx_locks,
-                "tx_done": self.tx_done,
-                "quotas": self.quotas,
-            }
-        )
+        # wire enc/dec tags the dataclasses living inside op results
+        # (uniq_seen replays) — import here: meta.wire imports this module
+        from chubaofs_tpu.meta import wire
+
+        w = snapcodec.SnapshotWriter()
+        w.add("meta", {
+            "partition_id": self.partition_id, "start": self.start,
+            "end": self.end, "cursor": self.cursor, "del_seq": self.del_seq,
+        })
+        w.add_batched("inodes", (self._inode_wire(i) for i in self.inodes.values()))
+        w.add_batched("dentries", ([d.parent, d.name, d.ino, d.mode]
+                                   for d in self.dentries.values()))
+        w.add_batched("orphans", (self._inode_wire(i) for i in self.orphans.values()))
+        w.add("freelist", self.freelist)
+        w.add("del_extents", self.del_extents)
+        w.add("multipart", self.multipart)
+        w.add("uniq_seen", wire.enc(self.uniq_seen))
+        w.add("txns", self.txns)
+        w.add("tx_locks", self.tx_locks)
+        w.add("tx_done", self.tx_done)
+        w.add("quotas", self.quotas)
+        return w.getvalue()
 
     def restore(self, payload: bytes) -> None:
-        st = pickle.loads(payload)
-        self.partition_id = st["partition_id"]
-        self.start, self.end, self.cursor = st["start"], st["end"], st["cursor"]
-        self.inodes = st["inodes"]
-        self.dentries = st["dentries"]
-        self.freelist = st["freelist"]
-        self.orphans = st.get("orphans", {})
-        self.del_extents = st.get("del_extents", [])
-        self.del_seq = st.get("del_seq", 0)
-        self.multipart = st["multipart"]
-        self.uniq_seen = st["uniq_seen"]
-        self.txns = st.get("txns", {})
-        self.tx_locks = st.get("tx_locks", {})
-        self.tx_done = st.get("tx_done", {})
-        self.quotas = st.get("quotas", {})
-        self.children = {}
-        for d in self.dentries.values():
-            self.children.setdefault(d.parent, {})[d.name] = d
+        from chubaofs_tpu.meta import wire
+
+        self.inodes, self.dentries, self.children, self.orphans = {}, {}, {}, {}
+
+        def load_meta(m):
+            self.partition_id = m["partition_id"]
+            self.start, self.end = m["start"], m["end"]
+            self.cursor, self.del_seq = m["cursor"], m["del_seq"]
+
+        def load_inodes(batch):
+            for rec in batch:
+                i = self._inode_unwire(rec)
+                self.inodes[i.ino] = i
+
+        def load_dentries(batch):
+            for parent, name, ino, mode in batch:
+                d = Dentry(parent, name, ino, mode)
+                self.dentries[(parent, name)] = d
+                self.children.setdefault(parent, {})[name] = d
+
+        def load_orphans(batch):
+            for rec in batch:
+                i = self._inode_unwire(rec)
+                self.orphans[i.ino] = i
+
+        snapcodec.restore_sections(payload, {
+            "meta": load_meta,
+            "inodes": load_inodes,
+            "dentries": load_dentries,
+            "orphans": load_orphans,
+            "freelist": lambda v: setattr(self, "freelist", list(v)),
+            "del_extents": lambda v: setattr(
+                self, "del_extents", [tuple(e) for e in v]),
+            "multipart": lambda v: setattr(self, "multipart", dict(v)),
+            "uniq_seen": lambda v: setattr(self, "uniq_seen", wire.dec(v)),
+            "txns": lambda v: setattr(self, "txns", dict(v)),
+            "tx_locks": lambda v: setattr(self, "tx_locks", dict(v)),
+            "tx_done": lambda v: setattr(self, "tx_done", dict(v)),
+            "quotas": lambda v: setattr(self, "quotas", dict(v)),
+        })
 
     # -- fsm ops: inodes -------------------------------------------------------
 

@@ -16,13 +16,12 @@ multipart_*), so the SDK works unchanged against local objects or TCP.
 
 from __future__ import annotations
 
-import base64
 import json
 import socket
 import threading
 
 from chubaofs_tpu.meta.metanode import MetaNode, OpError
-from chubaofs_tpu.meta.partition import Dentry, ExtentKey, Inode
+from chubaofs_tpu.meta.wire import dec, enc
 from chubaofs_tpu.proto.packet import (
     OP_META_OP,
     Packet,
@@ -37,55 +36,6 @@ from chubaofs_tpu.raft.server import NotLeaderError
 # ops served from leader state without a raft round (metanode read path)
 READ_OPS = {"lookup", "get_inode", "read_dir", "multipart_get",
             "multipart_list", "quota_usage", "tx_status", "dump_namespace"}
-
-
-# -- value (de)serialization ---------------------------------------------------
-# Results carry dataclasses (Inode/Dentry/ExtentKey) and bytes (xattrs); JSON
-# gets a tagged encoding both ends understand.
-
-
-def enc(v):
-    if isinstance(v, Inode):
-        d = {k: enc(getattr(v, k)) for k in (
-            "ino", "mode", "uid", "gid", "size", "nlink", "ctime", "mtime",
-            "extents", "obj_extents", "xattrs")}
-        return {"__inode__": d}
-    if isinstance(v, Dentry):
-        return {"__dentry__": {"parent": v.parent, "name": v.name,
-                               "ino": v.ino, "mode": v.mode}}
-    if isinstance(v, ExtentKey):
-        return {"__ek__": {"file_offset": v.file_offset, "size": v.size,
-                           "partition_id": v.partition_id,
-                           "extent_id": v.extent_id,
-                           "extent_offset": v.extent_offset}}
-    if isinstance(v, (bytes, bytearray)):
-        return {"__bytes__": base64.b64encode(bytes(v)).decode()}
-    if isinstance(v, tuple):
-        return {"__tuple__": [enc(x) for x in v]}
-    if isinstance(v, list):
-        return [enc(x) for x in v]
-    if isinstance(v, dict):
-        return {k: enc(x) for k, x in v.items()}
-    return v
-
-
-def dec(v):
-    if isinstance(v, dict):
-        if "__inode__" in v:
-            d = {k: dec(x) for k, x in v["__inode__"].items()}
-            return Inode(**d)
-        if "__dentry__" in v:
-            return Dentry(**v["__dentry__"])
-        if "__ek__" in v:
-            return ExtentKey(**v["__ek__"])
-        if "__bytes__" in v:
-            return base64.b64decode(v["__bytes__"])
-        if "__tuple__" in v:
-            return tuple(dec(x) for x in v["__tuple__"])
-        return {k: dec(x) for k, x in v.items()}
-    if isinstance(v, list):
-        return [dec(x) for x in v]
-    return v
 
 
 class MetaService:

@@ -16,11 +16,11 @@ from __future__ import annotations
 
 import json
 import os
-import pickle
 import threading
 import time
 from concurrent.futures import Future
 
+from chubaofs_tpu.raft import codec
 from chubaofs_tpu.raft.core import Entry, Msg, NotLeaderError, RaftCore, ROLE_LEADER
 
 
@@ -118,7 +118,14 @@ class _Group:
                             continue
                         # truncate conflicts, then append
                         self.core.entries = self.core.entries[: idx - self.core.offset - 1]
-                        data = pickle.loads(bytes.fromhex(blob)) if blob else None
+                        try:
+                            data = codec.loads(bytes.fromhex(blob)) if blob else None
+                        except codec.CodecError:
+                            raise RuntimeError(
+                                f"{self.wal_path}: WAL entry is not in the "
+                                "current (codec) format — this walDir was "
+                                "written by an incompatible build; move it "
+                                "aside to start fresh") from None
                         self.core.entries.append(Entry(term, data))
                     elif rec[0] == "commit":
                         idx = min(rec[1], self.core.last_index)
@@ -142,7 +149,7 @@ class _Group:
         if hard_state_changed:
             self.wal.write(json.dumps(["hs", self.core.term, self.core.voted_for]) + "\n")
         for idx, ent in new_entries:
-            blob = pickle.dumps(ent.data).hex() if ent.data is not None else ""
+            blob = codec.dumps(ent.data).hex() if ent.data is not None else ""
             self.wal.write(json.dumps(["ent", idx, ent.term, blob]) + "\n")
         self.wal.write(json.dumps(["commit", commit]) + "\n")
         self.wal.flush()
@@ -169,7 +176,7 @@ class _Group:
         self.wal.write(json.dumps(["hs", self.core.term, self.core.voted_for]) + "\n")
         for i in range(self.core.offset + 1, self.core.last_index + 1):
             ent = self.core.entry_at(i)
-            blob = pickle.dumps(ent.data).hex() if ent.data is not None else ""
+            blob = codec.dumps(ent.data).hex() if ent.data is not None else ""
             self.wal.write(json.dumps(["ent", i, ent.term, blob]) + "\n")
         self.wal.write(json.dumps(["commit", self.core.commit]) + "\n")
         self.wal.flush()

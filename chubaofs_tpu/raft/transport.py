@@ -10,31 +10,63 @@ threads so a slow peer never stalls the tick loop. Changed: one port instead
 of two — heartbeats here are tiny Msg batches on the same framed stream, so
 a separate heartbeat listener buys nothing.
 
-Framing: [u32 length][32B HMAC-SHA256][pickled list[Msg]]. Frames are
-authenticated with the cluster secret before unpickling — the transport
-trusts only peers holding the secret (the reference trusts its cluster
-network the same way; the HMAC gate is the authnode-flavored hardening).
+Framing: [u32 length][32B HMAC-SHA256][codec-encoded list[Msg]]. The payload
+is a safe tagged-binary encoding (raft.codec) that can only ever decode to
+plain values — a hostile frame cannot make the decoder run code, so the HMAC
+is an integrity/anti-spoof gate, not the last line of defense. Binding the
+listener off-loopback REQUIRES an explicit cluster secret (refused at start
+otherwise): with the well-known default secret any network peer could inject
+raft traffic and corrupt consensus state.
 """
 
 from __future__ import annotations
 
 import hashlib
 import hmac
-import pickle
 import queue
 import socket
 import struct
 import threading
 
-from chubaofs_tpu.raft.core import Msg
+from chubaofs_tpu.raft import codec
+from chubaofs_tpu.raft.core import Entry, Msg
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 256 << 20  # a snapshot install rides one frame
 DEFAULT_SECRET = b"chubaofs-tpu-raft"
 
+# Msg fields in wire order; entries ride separately as (term, data) pairs
+_MSG_FIELDS = (
+    "type", "group", "src", "dst", "term", "last_log_index", "last_log_term",
+    "granted", "prev_index", "prev_term", "commit", "success", "match_index",
+    "snap_index", "snap_term", "snap_data",
+)
+
+
+def _wire_msgs(msgs: list[Msg]) -> list:
+    return [
+        [[getattr(m, f) for f in _MSG_FIELDS],
+         [(e.term, e.data) for e in m.entries]]
+        for m in msgs
+    ]
+
+
+def _unwire_msgs(v) -> list[Msg]:
+    if not isinstance(v, list):
+        raise codec.CodecError("frame is not a message batch")
+    out = []
+    for item in v:
+        fields, ents = item
+        if len(fields) != len(_MSG_FIELDS):
+            raise codec.CodecError("bad message field count")
+        m = Msg(**dict(zip(_MSG_FIELDS, fields)))
+        m.entries = [Entry(term, data) for term, data in ents]
+        out.append(m)
+    return out
+
 
 def _pack(secret: bytes, msgs: list[Msg]) -> bytes:
-    payload = pickle.dumps(msgs, protocol=pickle.HIGHEST_PROTOCOL)
+    payload = codec.dumps(_wire_msgs(msgs))
     mac = hmac.new(secret, payload, hashlib.sha256).digest()
     return _LEN.pack(len(payload)) + mac + payload
 
@@ -127,6 +159,11 @@ class TcpNet:
         self._stop = threading.Event()
 
         host, port = self.peers[node_id].rsplit(":", 1)
+        if secret == DEFAULT_SECRET and host not in ("127.0.0.1", "localhost", "::1"):
+            raise ValueError(
+                "raft transport bound off-loopback requires an explicit "
+                "cluster secret (set 'raftSecret' in the daemon config); "
+                "refusing to start with the well-known default")
         self.listener = socket.create_server((host, int(port)))
         self.listen_addr = f"{host}:{self.listener.getsockname()[1]}"
         self.peers[node_id] = self.listen_addr
@@ -190,7 +227,10 @@ class TcpNet:
                 want = hmac.new(self.secret, payload, hashlib.sha256).digest()
                 if not hmac.compare_digest(mac, want):
                     return  # unauthenticated frame: drop the connection
-                msgs = pickle.loads(payload)
+                try:
+                    msgs = _unwire_msgs(codec.loads(payload))
+                except (codec.CodecError, TypeError, ValueError):
+                    return  # malformed frame: hostile or corrupt — drop conn
                 if self.node is not None:
                     self.node.deliver(msgs)
         except (ConnectionError, OSError):
