@@ -135,8 +135,10 @@ class MasterDaemon(_Daemon):
         self.master.datanode_hook = self._data_hook
         self.master.raft_config_hook = self._raft_config_hook
         self.master.remove_partition_hook = self._remove_partition_hook
+        svc_secret = cfg.get("serviceSecret")
         self.api = MasterAPI(self.master,
-                             leader_addr_of=lambda nid: self.peer_apis.get(nid, ""))
+                             leader_addr_of=lambda nid: self.peer_apis.get(nid, ""),
+                             service_secret=svc_secret.encode() if svc_secret else None)
         host, port = _addr_split(cfg.get("listen", "127.0.0.1:0"))
         self.server = RPCServer(self.api.router, host=host, port=port).start()
         self.addr = self.server.addr
@@ -587,7 +589,19 @@ class ObjectNodeDaemon(_Daemon):
                                      access_addrs=cfg.get("accessAddrs"))
         users = cfg.get("users")
         if users is None:
-            users = _MasterUserStore(self.cluster.mc)
+            svc_secret = cfg.get("serviceSecret")
+            if svc_secret:
+                users = _MasterUserStore(MasterClient(
+                    cfg["masterAddrs"], auth_secret=svc_secret.encode()))
+            else:
+                if any(not a.startswith(("127.0.0.1", "localhost", "[::1]"))
+                       for a in cfg["masterAddrs"]):
+                    _log("objectnode",
+                         "no serviceSecret configured and masters are "
+                         "non-loopback: the master will refuse /user/akInfo, "
+                         "so ALL S3 authentication will fail — set the same "
+                         "serviceSecret on masters and this objectnode")
+                users = _MasterUserStore(self.cluster.mc)
         self.objectnode = ObjectNode(self.cluster, users=users,
                                      region=cfg.get("region", "cfs"))
         host, port = _addr_split(cfg.get("listen", "127.0.0.1:0"))

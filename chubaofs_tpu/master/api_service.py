@@ -32,10 +32,16 @@ def envelope(data=None, code: int = CODE_OK, msg: str = "success") -> dict:
 class MasterAPI:
     """HTTP service bound to one master replica."""
 
-    def __init__(self, master: Master, leader_addr_of=None):
-        """leader_addr_of: node_id -> admin-API address, for leader redirects."""
+    def __init__(self, master: Master, leader_addr_of=None,
+                 service_secret: bytes | None = None):
+        """leader_addr_of: node_id -> admin-API address, for leader redirects.
+        service_secret gates the credential-bearing /user/akInfo endpoint
+        (objectnode signs with it); without one, akInfo only answers loopback
+        clients — S3 secrets must never be harvestable off the open admin API
+        (round-1 advisory)."""
         self.master = master
         self.leader_addr_of = leader_addr_of or (lambda node_id: "")
+        self.service_secret = service_secret
         self.router = self._build()
 
     # -- plumbing -------------------------------------------------------------
@@ -178,18 +184,40 @@ class MasterAPI:
     def decommission_data(self, req: Request):
         return {"migrated": self.master.decommission_datanode(int(req.q("id")))}
 
+    @staticmethod
+    def _user_view(u) -> dict:
+        """Public user record: the secret key is returned ONLY at create time
+        and over the gated akInfo path — list/info must not leak S3
+        credentials through the unauthenticated admin API."""
+        d = asdict(u)
+        d.pop("secret_key", None)
+        return d
+
     def user_create(self, req: Request):
-        u = self.master.create_user(req.q("user"), req.q("type", "normal"))
-        return asdict(u)
+        # create-time is the one moment the caller gets the secret back
+        return asdict(self.master.create_user(req.q("user"),
+                                              req.q("type", "normal")))
 
     def user_delete(self, req: Request):
         self.master.delete_user(req.q("user"))
         return None
 
     def user_info(self, req: Request):
-        return asdict(self.master.get_user(req.q("user")))
+        return self._user_view(self.master.get_user(req.q("user")))
 
     def user_ak_info(self, req: Request):
+        from chubaofs_tpu.rpc.server import AUTH_HEADER, sign_path
+
+        if self.service_secret is not None:
+            import hmac as _hmac
+
+            want = sign_path(self.service_secret, "/user/akInfo")
+            if not _hmac.compare_digest(req.header(AUTH_HEADER), want):
+                raise MasterError("akInfo requires the service secret")
+        elif req.remote not in ("-", "127.0.0.1", "::1", "localhost"):
+            raise MasterError(
+                "akInfo without a configured serviceSecret answers loopback "
+                "clients only")
         return asdict(self.master.user_by_ak(req.q("ak")))
 
     def user_update_policy(self, req: Request):
@@ -197,10 +225,10 @@ class MasterAPI:
         u = self.master.update_user_policy(
             req.q("user"), req.q("vol"), actions,
             grant=req.q("grant", "true") != "false")
-        return asdict(u)
+        return self._user_view(u)
 
     def user_list(self, req: Request):
-        return [asdict(u) for u in self.master.sm.users.values()]
+        return [self._user_view(u) for u in self.master.sm.users.values()]
 
     def serve(self, addr: str) -> RPCServer:
         host, port = addr.rsplit(":", 1)
@@ -212,8 +240,10 @@ class MasterAPI:
 class MasterClient:
     """sdk/master analog: follows the not-leader hint across replicas."""
 
-    def __init__(self, hosts: list[str], retries: int = 4):
-        self.rpc = RPCClient(hosts, retries=retries)
+    def __init__(self, hosts: list[str], retries: int = 4,
+                 auth_secret: bytes | None = None):
+        self.auth_secret = auth_secret
+        self.rpc = RPCClient(hosts, retries=retries, auth_secret=auth_secret)
         self.leader_hint: str | None = None
 
     @staticmethod
@@ -230,7 +260,8 @@ class MasterClient:
         last_msg = "no reply"
         for _ in range(4):
             if self.leader_hint:
-                rpc = RPCClient([self.leader_hint], retries=1)
+                rpc = RPCClient([self.leader_hint], retries=1,
+                                auth_secret=self.auth_secret)
                 try:
                     out = rpc.get(path)
                 except (HTTPError, OSError):

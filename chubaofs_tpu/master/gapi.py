@@ -198,8 +198,10 @@ class GraphQLAPI:
         return self._vol_dict(self.master.get_volume(self._arg(args, "name")))
 
     def _user_dict(self, u):
+        # no secretKey: the console proxies GraphQL to any browser, and S3
+        # credentials must not be harvestable there (round-1 advisory)
         return {"userID": u.user_id, "accessKey": u.access_key,
-                "secretKey": u.secret_key, "userType": u.user_type,
+                "userType": u.user_type,
                 "ownVols": list(u.own_vols),
                 "authorizedVols": dict(u.authorized_vols)}
 

@@ -168,7 +168,10 @@ class MasterSM(StateMachine):
         return self.next_id
 
     def _op_register_node(self, node_id: int, kind: str, addr: str,
-                          raft_addr: str = ""):
+                          raft_addr: str = "", now: float = 0.0):
+        # `now` is stamped by the PROPOSER: calling time.time() inside apply
+        # would make replicas and WAL replay record different values, so a
+        # restarted master could trust dead nodes as freshly heartbeaten
         if node_id not in self.nodes:
             self.nodes[node_id] = NodeInfo(node_id, kind, addr)
         n = self.nodes[node_id]
@@ -179,14 +182,15 @@ class MasterSM(StateMachine):
             n.addr = addr
         if raft_addr:
             n.raft_addr = raft_addr
-        n.last_heartbeat = time.time()
+        n.last_heartbeat = max(n.last_heartbeat, now)
         return node_id
 
-    def _op_heartbeat(self, node_id: int, partition_count: int = 0, cursors: dict | None = None):
+    def _op_heartbeat(self, node_id: int, partition_count: int = 0,
+                      cursors: dict | None = None, now: float = 0.0):
         n = self.nodes.get(node_id)
         if n is None:
             raise MasterError(f"unknown node {node_id}")
-        n.last_heartbeat = time.time()
+        n.last_heartbeat = max(n.last_heartbeat, now)
         n.partition_count = partition_count
         # a dict REPLACES the cursor set (even when empty — a restarted node
         # reports no partitions, and the ensure sweep must see that to re-send
@@ -393,11 +397,11 @@ class Master:
     def register_node(self, node_id: int, kind: str, addr: str = "",
                       raft_addr: str = "") -> None:
         self._apply("register_node", node_id=node_id, kind=kind, addr=addr,
-                    raft_addr=raft_addr)
+                    raft_addr=raft_addr, now=time.time())
 
     def heartbeat(self, node_id: int, partition_count: int = 0, cursors: dict | None = None):
         self._apply("heartbeat", node_id=node_id, partition_count=partition_count,
-                    cursors=cursors)
+                    cursors=cursors, now=time.time())
 
     # -- volume admin -----------------------------------------------------------
 

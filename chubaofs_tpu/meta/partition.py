@@ -130,10 +130,13 @@ class MetaPartitionSM(StateMachine):
         # intent locks until commit/rollback/expiry
         self.txns: dict[str, dict] = {}  # tx_id -> {ops, deadline}
         self.tx_locks: dict[tuple, str] = {}  # lock key -> tx_id
-        self.tx_done: dict[str, str] = {}  # tx_id -> "committed"|"rolledback"
+        # tx_id -> (decision, retain-until); decisions outlive the txn by
+        # TX_DONE_RETAIN so late-resolving participants always find them
+        self.tx_done: dict[str, tuple[str, float]] = {}
         # directory quotas (metanode quota + master_quota_manager):
         # qid -> {max_files, max_bytes, files, bytes, exceeded}
         self.quotas: dict[int, dict] = {}
+        self._apply_now = 0.0  # proposer-stamped wall clock of the last op
         if start == ROOT_INO:
             root = Inode(ino=ROOT_INO, mode=stat_mod.S_IFDIR | 0o755, nlink=2)
             self.inodes[ROOT_INO] = root
@@ -146,6 +149,11 @@ class MetaPartitionSM(StateMachine):
     def apply(self, data, index: int):
         op, args = data
         uniq = args.get("_uniq")  # never mutate args: the tuple is shared
+        if "_now" in args:
+            # wall time rides the PROPOSAL: replicas and WAL replay must stamp
+            # identical ctimes/mtimes, so apply never reads the local clock
+            self._apply_now = args["_now"]
+            args = {k: v for k, v in args.items() if k != "_now"}
         if uniq is not None:
             cid, uid = uniq
             hist = self.uniq_seen.get(cid)
@@ -271,7 +279,8 @@ class MetaPartitionSM(StateMachine):
     def _op_create_inode(self, mode: int, uid: int = 0, gid: int = 0,
                          quota_ids: list[int] | None = None):
         ino = self._next_ino()
-        inode = Inode(ino=ino, mode=mode, uid=uid, gid=gid)
+        inode = Inode(ino=ino, mode=mode, uid=uid, gid=gid,
+                      ctime=self._apply_now, mtime=self._apply_now)
         if inode.is_dir:
             inode.nlink = 2
         if quota_ids:  # subtree quota ids stick to the inode for byte charges
@@ -324,7 +333,7 @@ class MetaPartitionSM(StateMachine):
             inode.uid = uid
         if gid is not None:
             inode.gid = gid
-        inode.mtime = mtime if mtime is not None else time.time()
+        inode.mtime = mtime if mtime is not None else self._apply_now
         return inode
 
     def _op_append_extents(self, ino: int, extents: list[dict], size: int):
@@ -336,7 +345,7 @@ class MetaPartitionSM(StateMachine):
         for e in extents:
             inode.extents.append(ExtentKey(**e))
         inode.size = max(inode.size, size)
-        inode.mtime = time.time()
+        inode.mtime = self._apply_now
         return inode
 
     def _op_append_obj_extents(self, ino: int, locations: list[dict], size: int):
@@ -347,7 +356,7 @@ class MetaPartitionSM(StateMachine):
             self._quota_charge_bytes(self._inode_quota_ids(inode), grow)
         inode.obj_extents.extend(locations)
         inode.size = max(inode.size, size)
-        inode.mtime = time.time()
+        inode.mtime = self._apply_now
         return inode
 
     def _op_truncate(self, ino: int, size: int):
@@ -380,7 +389,7 @@ class MetaPartitionSM(StateMachine):
                 "obj_extents": dropped_obj,
             }))
         inode.size = size
-        inode.mtime = time.time()
+        inode.mtime = self._apply_now
         return inode
 
     def _op_set_xattr(self, ino: int, key: str, value: bytes):
@@ -399,34 +408,49 @@ class MetaPartitionSM(StateMachine):
 
     def _op_create_dentry(self, parent: int, name: str, ino: int, mode: int,
                           quota_ids: list[int] | None = None,
-                          _tx: str | None = None):
+                          _tx: str | None = None, _committing: bool = False):
+        """_committing=True is the 2PC commit replay: every check already ran
+        (and quota was RESERVED) at prepare, so nothing here may fail — a
+        failure after the TM decision would leave the txn half-applied."""
         key = (parent, name)
-        self._check_lock(("d", parent, name), _tx)
-        self._check_lock(("c", parent), _tx)  # dir-delete freezes the child set
+        if not _committing:
+            self._check_lock(("d", parent, name), _tx)
+            self._check_lock(("c", parent), _tx)  # dir-delete freezes the child set
         if key in self.dentries:
             raise Exists(f"{name!r} exists in {parent}")
         pdir = self._get_inode(parent)
         if not pdir.is_dir:
             raise NotDir(f"parent {parent}")
-        self._quota_charge_files(quota_ids, +1)
+        if not _committing:  # committed txns charged at prepare
+            self._quota_charge_files(quota_ids, +1)
         d = Dentry(parent, name, ino, mode)
         self.dentries[key] = d
         self.children.setdefault(parent, {})[name] = d
         if stat_mod.S_ISDIR(mode):
             pdir.nlink += 1
-        pdir.mtime = time.time()
+        pdir.mtime = self._apply_now
         return d
 
     def _op_delete_dentry(self, parent: int, name: str,
                           quota_ids: list[int] | None = None,
-                          _tx: str | None = None):
+                          _tx: str | None = None, _committing: bool = False):
         key = (parent, name)
-        self._check_lock(("d", parent, name), _tx)
+        if not _committing:
+            self._check_lock(("d", parent, name), _tx)
         d = self.dentries.get(key)
         if d is None:
             raise NoEntry(f"{name!r} in {parent}")
-        if stat_mod.S_ISDIR(d.mode) and self.children.get(d.ino):
-            raise NotEmpty(f"{name!r}")
+        if stat_mod.S_ISDIR(d.mode):
+            if self.children.get(d.ino):
+                raise NotEmpty(f"{name!r}")
+            if not _committing:
+                # a PREPARED create inside this directory holds ("d", d.ino, *):
+                # deleting the dir now would make that txn's commit fail after
+                # the TM decision — the commit-cannot-fail invariant's reverse
+                # direction, so the plain rmdir path must conflict too
+                for lk, holder in self.tx_locks.items():
+                    if lk[0] == "d" and lk[1] == d.ino and holder != _tx:
+                        raise TxConflict(f"dir {d.ino} has pending txn {holder}")
         self._quota_charge_files(quota_ids, -1)
         del self.dentries[key]
         self.children.get(parent, {}).pop(name, None)
@@ -434,7 +458,7 @@ class MetaPartitionSM(StateMachine):
         if pdir:
             if stat_mod.S_ISDIR(d.mode):
                 pdir.nlink -= 1
-            pdir.mtime = time.time()
+            pdir.mtime = self._apply_now
         return d
 
     def _op_rename_local(self, src_parent: int, src_name: str, dst_parent: int,
@@ -511,10 +535,17 @@ class MetaPartitionSM(StateMachine):
             keys.append(("c", args["_lock_children"]))
         return keys
 
+    # a decision outlives its txn's deadline by this much, so a participant
+    # resolving within TX_TTL + sweep slack ALWAYS finds it (the round-1
+    # advisor showed count-based pruning could forget a commit inside that
+    # window and roll a committed rename half back)
+    TX_DONE_RETAIN = 120.0
+    TX_DONE_HARD_CAP = 1 << 16  # memory backstop, far above any live window
+
     def _op_tx_prepare(self, tx_id: str, ops: list, deadline: float,
                        tm_pid: int = 0):
         if tx_id in self.tx_done:
-            raise TxConflict(f"txn {tx_id} already {self.tx_done[tx_id]}")
+            raise TxConflict(f"txn {tx_id} already {self.tx_done[tx_id][0]}")
         if tx_id in self.txns:
             return None  # idempotent re-prepare
         prepared_ops = []
@@ -522,14 +553,17 @@ class MetaPartitionSM(StateMachine):
             if op not in self.TX_OPS:
                 raise MetaError(f"op {op!r} not transactable")
             args = dict(args)
-            # dry-run validation so commit cannot fail later
+            # dry-run validation so commit CANNOT fail later: every check the
+            # commit replay would make must run (and conflict) here
             if op == "create_dentry":
                 if (args["parent"], args["name"]) in self.dentries:
                     raise Exists(f"{args['name']!r} exists in {args['parent']}")
                 pdir = self._get_inode(args["parent"])
                 if not pdir.is_dir:
                     raise NotDir(f"parent {args['parent']}")
-                self._quota_check_files(args.get("quota_ids"))
+                # a prepared dir-delete of the parent must conflict NOW, not
+                # at commit time
+                self._check_lock(("c", args["parent"]))
             elif op == "delete_dentry":
                 d = self.dentries.get((args["parent"], args["name"]))
                 if d is None:
@@ -537,10 +571,30 @@ class MetaPartitionSM(StateMachine):
                 if stat_mod.S_ISDIR(d.mode):
                     if self.children.get(d.ino):
                         raise NotEmpty(args["name"])
+                    # a prepared create INSIDE this directory would repopulate
+                    # it between our emptiness check and commit
+                    for key, holder in self.tx_locks.items():
+                        if key[0] == "d" and key[1] == d.ino and holder != tx_id:
+                            raise TxConflict(
+                                f"dir {d.ino} has pending txn {holder}")
                     args["_lock_children"] = d.ino
             for key in self._tx_lock_keys(op, args):
                 self._check_lock(key)
             prepared_ops.append((op, args))
+        # RESERVE quota at prepare (released on rollback): the commit replay
+        # must never hit EDQUOT because the quota filled in between. A
+        # mid-loop failure must undo the charges already made — prepare
+        # failed, so no txn exists to roll them back later.
+        charged = []
+        try:
+            for op, args in prepared_ops:
+                if op == "create_dentry":
+                    self._quota_charge_files(args.get("quota_ids"), +1)
+                    charged.append(args.get("quota_ids"))
+        except QuotaExceeded:
+            for qids in charged:
+                self._quota_charge_files(qids, -1)
+            raise
         for op, args in prepared_ops:
             for key in self._tx_lock_keys(op, args):
                 self.tx_locks[key] = tx_id
@@ -548,52 +602,67 @@ class MetaPartitionSM(StateMachine):
                             "tm_pid": tm_pid or self.partition_id}
         return None
 
-    def _release_tx(self, tx_id: str):
+    def _release_tx(self, tx_id: str, undo_reservations: bool):
+        txn = self.txns.pop(tx_id, None)
+        if txn is not None and undo_reservations:
+            for op, args in txn["ops"]:
+                if op == "create_dentry":
+                    self._quota_charge_files(args.get("quota_ids"), -1)
         self.tx_locks = {k: t for k, t in self.tx_locks.items() if t != tx_id}
-        self.txns.pop(tx_id, None)
-        if len(self.tx_done) > 1024:  # bounded memory of finished txns
-            for k in list(self.tx_done)[:512]:
+        if len(self.tx_done) > self.TX_DONE_HARD_CAP:
+            for k in list(self.tx_done)[: self.TX_DONE_HARD_CAP // 2]:
                 del self.tx_done[k]
 
+    def _done_stamp(self, txn: dict) -> float:
+        """Decision retention deadline, derived from the txn's own deadline so
+        every replica computes the identical value (no wall clock in apply)."""
+        return txn["deadline"] + self.TX_DONE_RETAIN
+
     def _op_tx_commit(self, tx_id: str):
-        if self.tx_done.get(tx_id) == "committed":
+        if tx_id in self.tx_done and self.tx_done[tx_id][0] == "committed":
             return None  # idempotent re-commit
         txn = self.txns.get(tx_id)
         if txn is None:
-            raise TxConflict(f"txn {tx_id} not prepared "
-                             f"({self.tx_done.get(tx_id, 'unknown')})")
+            raise TxConflict(
+                f"txn {tx_id} not prepared "
+                f"({self.tx_done.get(tx_id, ('unknown',))[0]})")
         for op, args in txn["ops"]:
             run_args = {k: v for k, v in args.items() if k != "_lock_children"}
-            getattr(self, "_op_" + op)(**run_args, _tx=tx_id)
-        self.tx_done[tx_id] = "committed"
-        self._release_tx(tx_id)
+            getattr(self, "_op_" + op)(**run_args, _tx=tx_id, _committing=True)
+        self.tx_done[tx_id] = ("committed", self._done_stamp(txn))
+        self._release_tx(tx_id, undo_reservations=False)
         return None
 
     def _op_tx_rollback(self, tx_id: str):
-        if tx_id in self.txns:
-            self.tx_done[tx_id] = "rolledback"
-            self._release_tx(tx_id)
+        txn = self.txns.get(tx_id)
+        if txn is not None:
+            self.tx_done[tx_id] = ("rolledback", self._done_stamp(txn))
+            self._release_tx(tx_id, undo_reservations=True)
         return None
 
     def _op_tx_sweep(self, now: float):
         """Resolve expired prepared txns. TM-anchored txns roll back here (no
         commit decision was ever recorded); participant txns are RETURNED for
-        the metanode to resolve against their TM partition."""
+        the metanode to resolve against their TM partition. Also prunes
+        decisions whose retention window has lapsed — never earlier."""
         unresolved = []
         for t, txn in list(self.txns.items()):
             if txn["deadline"] >= now:
                 continue
             if txn["tm_pid"] == self.partition_id:
-                self.tx_done[t] = "rolledback"
-                self._release_tx(t)
+                self.tx_done[t] = ("rolledback", self._done_stamp(txn))
+                self._release_tx(t, undo_reservations=True)
             else:
                 unresolved.append((t, txn["tm_pid"]))
+        for t, (_, expire) in list(self.tx_done.items()):
+            if now > expire:
+                del self.tx_done[t]
         return unresolved
 
     def tx_status(self, tx_id: str) -> str:
         """TM-side decision lookup: committed | rolledback | prepared | unknown."""
         if tx_id in self.tx_done:
-            return self.tx_done[tx_id]
+            return self.tx_done[tx_id][0]
         if tx_id in self.txns:
             return "prepared"
         return "unknown"

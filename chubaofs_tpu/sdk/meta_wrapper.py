@@ -100,6 +100,9 @@ class MetaWrapper:
         # the uniq id makes the mutation idempotent end-to-end, so even an
         # after-send connection loss (EIO) may retry safely
         args["_uniq"] = (self.client_id, next(self._uniq))
+        # wall time stamps ride the proposal so every replica applies the
+        # identical ctime/mtime (no clock reads inside the state machine)
+        args.setdefault("_now", time.time())
         return self._on_partition(
             mp, lambda node: node.submit_sync(mp.partition_id, op, **args),
             idempotent=True,

@@ -157,7 +157,12 @@ def test_daemon_user_store(cluster):
     got = mc.user_by_ak(u["access_key"])
     assert got["secret_key"] == u["secret_key"]
     mc.update_user_policy("alice", "dvol", ["perm:writable"])
-    assert mc.user_info("alice")["authorized_vols"]["dvol"] == ["perm:writable"]
+    info = mc.user_info("alice")
+    assert info["authorized_vols"]["dvol"] == ["perm:writable"]
+    # credentials only at create time / gated akInfo — never via list/info
+    # over the open admin API (round-1 advisory)
+    assert "secret_key" not in info
+    assert all("secret_key" not in x for x in mc.list_users())
     with pytest.raises(MasterError):
         mc.create_user("alice")
     mc.delete_user("alice")

@@ -85,7 +85,14 @@ def test_tx_rollback_and_expiry():
     # back locally — the coordinator never recorded a commit decision
     sm.apply(("tx_prepare", {"tx_id": "tx4", "ops": ops, "deadline": 5.0}), 5)
     assert sm.apply(("tx_sweep", {"now": 10.0}), 6) == ("ok", [])
-    assert not sm.txns and sm.tx_done["tx4"] == "rolledback"
+    assert not sm.txns and sm.tx_status("tx4") == "rolledback"
+    # the decision is retained through the resolve window, then pruned by
+    # TTL — never by count (round-1 advisory: count-pruning could forget a
+    # commit mid-window and roll a committed rename half back)
+    sm.apply(("tx_sweep", {"now": 5.0 + sm.TX_DONE_RETAIN - 1}), 7)
+    assert sm.tx_status("tx4") == "rolledback"
+    sm.apply(("tx_sweep", {"now": 5.0 + sm.TX_DONE_RETAIN + 1}), 8)
+    assert sm.tx_status("tx4") == "unknown"
 
 
 def test_tx_participant_expiry_resolves_via_tm():
@@ -121,6 +128,133 @@ def test_tx_dir_delete_locks_child_set():
     assert r[:2] == ("err", "ETXCONFLICT")
     assert sm.apply(("tx_commit", {"tx_id": "txd"}), 6)[0] == "ok"
     assert (1, "dir") not in sm.dentries
+
+
+def test_tx_commit_cannot_fail_on_quota_fill():
+    """Round-1 advisory: prepare RESERVES quota headroom, so a quota that
+    fills between prepare and commit cannot make commit raise EDQUOT."""
+    sm = mk_sm()
+    sm.apply(("set_quota_def", {"quota_id": 5, "max_files": 1}), 1)
+    ino = sm.apply(("create_inode", {"mode": stat.S_IFREG | 0o644}), 2)[1].ino
+    ops = [("create_dentry", {"parent": 1, "name": "a", "ino": ino,
+                              "mode": 0o644, "quota_ids": [5]})]
+    assert sm.apply(("tx_prepare", {"tx_id": "txq", "ops": ops,
+                                    "deadline": 1e12}), 3)[0] == "ok"
+    # the reservation fills the quota: a competing non-tx create fails NOW
+    ino2 = sm.apply(("create_inode", {"mode": stat.S_IFREG | 0o644}), 4)[1].ino
+    r = sm.apply(("create_dentry", {"parent": 1, "name": "b", "ino": ino2,
+                                    "mode": 0o644, "quota_ids": [5]}), 5)
+    assert r[:2] == ("err", "EDQUOT")
+    # ... and commit succeeds without double-charging
+    assert sm.apply(("tx_commit", {"tx_id": "txq"}), 6)[0] == "ok"
+    assert sm.quotas[5]["files"] == 1
+
+
+def test_tx_rollback_releases_quota_reservation():
+    sm = mk_sm()
+    sm.apply(("set_quota_def", {"quota_id": 6, "max_files": 1}), 1)
+    ino = sm.apply(("create_inode", {"mode": stat.S_IFREG | 0o644}), 2)[1].ino
+    ops = [("create_dentry", {"parent": 1, "name": "a", "ino": ino,
+                              "mode": 0o644, "quota_ids": [6]})]
+    sm.apply(("tx_prepare", {"tx_id": "txr", "ops": ops, "deadline": 1e12}), 3)
+    assert sm.quotas[6]["files"] == 1  # reserved
+    sm.apply(("tx_rollback", {"tx_id": "txr"}), 4)
+    assert sm.quotas[6]["files"] == 0  # released
+    r = sm.apply(("create_dentry", {"parent": 1, "name": "b", "ino": ino,
+                                    "mode": 0o644, "quota_ids": [6]}), 5)
+    assert r[0] == "ok"
+
+
+def test_tx_create_conflicts_with_prepared_dir_delete():
+    """The other half of the commit-cannot-fail invariant: a create whose
+    parent has a PREPARED dir-delete conflicts at prepare, not at commit."""
+    sm = mk_sm()
+    d_ino = sm.apply(("create_inode", {"mode": stat.S_IFDIR | 0o755}), 1)[1].ino
+    sm.apply(("create_dentry", {"parent": 1, "name": "dir", "ino": d_ino,
+                                "mode": stat.S_IFDIR | 0o755}), 2)
+    del_ops = [("delete_dentry", {"parent": 1, "name": "dir"})]
+    assert sm.apply(("tx_prepare", {"tx_id": "txA", "ops": del_ops,
+                                    "deadline": 1e12}), 3)[0] == "ok"
+    f_ino = sm.apply(("create_inode", {"mode": stat.S_IFREG | 0o644}), 4)[1].ino
+    crt_ops = [("create_dentry", {"parent": d_ino, "name": "x", "ino": f_ino,
+                                  "mode": 0o644})]
+    r = sm.apply(("tx_prepare", {"tx_id": "txB", "ops": crt_ops,
+                                 "deadline": 1e12}), 5)
+    assert r[:2] == ("err", "ETXCONFLICT")
+
+
+def test_tx_failed_prepare_releases_partial_quota_charges():
+    """A multi-create prepare that dies mid-reservation must undo the charges
+    it already made — there is no txn left to roll them back."""
+    sm = mk_sm()
+    sm.apply(("set_quota_def", {"quota_id": 7, "max_files": 1}), 1)
+    i1 = sm.apply(("create_inode", {"mode": stat.S_IFREG | 0o644}), 2)[1].ino
+    i2 = sm.apply(("create_inode", {"mode": stat.S_IFREG | 0o644}), 3)[1].ino
+    ops = [("create_dentry", {"parent": 1, "name": "a", "ino": i1,
+                              "mode": 0o644, "quota_ids": [7]}),
+           ("create_dentry", {"parent": 1, "name": "b", "ino": i2,
+                              "mode": 0o644, "quota_ids": [7]})]
+    r = sm.apply(("tx_prepare", {"tx_id": "txm", "ops": ops,
+                                 "deadline": 1e12}), 4)
+    assert r[:2] == ("err", "EDQUOT")
+    assert sm.quotas[7]["files"] == 0  # nothing leaked
+    ok = sm.apply(("create_dentry", {"parent": 1, "name": "a", "ino": i1,
+                                     "mode": 0o644, "quota_ids": [7]}), 5)
+    assert ok[0] == "ok"
+
+
+def test_plain_rmdir_blocked_by_pending_create_inside():
+    """Non-transactional rmdir of a dir with a PREPARED create inside must
+    conflict — otherwise that txn's commit fails after the TM decision."""
+    sm = mk_sm()
+    d_ino = sm.apply(("create_inode", {"mode": stat.S_IFDIR | 0o755}), 1)[1].ino
+    sm.apply(("create_dentry", {"parent": 1, "name": "dir", "ino": d_ino,
+                                "mode": stat.S_IFDIR | 0o755}), 2)
+    f_ino = sm.apply(("create_inode", {"mode": stat.S_IFREG | 0o644}), 3)[1].ino
+    ops = [("create_dentry", {"parent": d_ino, "name": "x", "ino": f_ino,
+                              "mode": 0o644})]
+    assert sm.apply(("tx_prepare", {"tx_id": "txE", "ops": ops,
+                                    "deadline": 1e12}), 4)[0] == "ok"
+    r = sm.apply(("delete_dentry", {"parent": 1, "name": "dir"}), 5)
+    assert r[:2] == ("err", "ETXCONFLICT")
+    assert sm.apply(("tx_commit", {"tx_id": "txE"}), 6)[0] == "ok"
+    assert (d_ino, "x") in sm.dentries
+    # with the txn resolved the rmdir would still fail — dir is non-empty now
+    assert sm.apply(("delete_dentry", {"parent": 1, "name": "dir"}),
+                    7)[:2] == ("err", "ENOTEMPTY")
+
+
+def test_mtime_rides_proposal():
+    """ctime/mtime come from the proposer's _now stamp, never the replica
+    clock — two replicas applying the same log agree bit-for-bit."""
+    sm = mk_sm()
+    r = sm.apply(("create_inode", {"mode": stat.S_IFREG | 0o644,
+                                   "_now": 1234.5}), 1)
+    assert r[1].ctime == 1234.5 and r[1].mtime == 1234.5
+    sm.apply(("create_dentry", {"parent": 1, "name": "t", "ino": r[1].ino,
+                                "mode": 0o644, "_now": 2000.0}), 2)
+    assert sm.inodes[1].mtime == 2000.0  # parent dir mtime from the proposal
+
+
+def test_tx_dir_delete_conflicts_with_prepared_create_inside():
+    """Reverse order: create prepared first, then the dir-delete prepare must
+    conflict (it would otherwise validate emptiness that commit invalidates)."""
+    sm = mk_sm()
+    d_ino = sm.apply(("create_inode", {"mode": stat.S_IFDIR | 0o755}), 1)[1].ino
+    sm.apply(("create_dentry", {"parent": 1, "name": "dir", "ino": d_ino,
+                                "mode": stat.S_IFDIR | 0o755}), 2)
+    f_ino = sm.apply(("create_inode", {"mode": stat.S_IFREG | 0o644}), 3)[1].ino
+    crt_ops = [("create_dentry", {"parent": d_ino, "name": "x", "ino": f_ino,
+                                  "mode": 0o644})]
+    assert sm.apply(("tx_prepare", {"tx_id": "txC", "ops": crt_ops,
+                                    "deadline": 1e12}), 4)[0] == "ok"
+    del_ops = [("delete_dentry", {"parent": 1, "name": "dir"})]
+    r = sm.apply(("tx_prepare", {"tx_id": "txD", "ops": del_ops,
+                                 "deadline": 1e12}), 5)
+    assert r[:2] == ("err", "ETXCONFLICT")
+    # create commits cleanly afterwards
+    assert sm.apply(("tx_commit", {"tx_id": "txC"}), 6)[0] == "ok"
+    assert (d_ino, "x") in sm.dentries
 
 
 # -- cross-partition rename through the cluster --------------------------------
