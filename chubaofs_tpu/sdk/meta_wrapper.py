@@ -10,6 +10,8 @@ finds the leader.
 
 from __future__ import annotations
 
+import time
+
 from chubaofs_tpu.master.master import MasterError, MetaPartitionView, VolumeView
 from chubaofs_tpu.meta.metanode import MetaNode, OpError
 from chubaofs_tpu.raft.server import NotLeaderError
@@ -60,8 +62,6 @@ class MetaWrapper:
         died AFTER the request went out) retries only when `idempotent` —
         a mutation may have applied before the reply was lost, and blindly
         re-submitting turns success into EEXIST/ENOENT."""
-        import time
-
         RETRYABLE = ("ECONN", "ENOPARTITION") + (("EIO",) if idempotent else ())
 
         deadline = time.time() + self.RETRY_WINDOW
@@ -182,7 +182,6 @@ class MetaWrapper:
         # partition is the transaction manager: its commit is THE decision —
         # committed there means every expired participant rolls forward, not
         # back (metanode sweep asks the TM via tx_status).
-        import time
         import uuid
 
         d = self._on_partition(src_mp, lambda n: n.lookup(src_mp.partition_id, src_parent, src_name))
