@@ -53,15 +53,45 @@ class LocationError(AccessError):
     pass
 
 
-def select_code_mode(size: int) -> CodeMode:
-    """Size-tiered code-mode choice (stream_put.go:64 SelectCodeMode analog):
-    small blobs favor low shard-count modes (less per-shard overhead), large
-    blobs favor wide stripes (better storage efficiency)."""
-    if size <= 128 * 1024:
-        return CodeMode.EC3P3
-    if size <= 1024 * 1024:
-        return CodeMode.EC6P3
-    return CodeMode.EC12P4
+@dataclass(frozen=True)
+class CodeModePolicy:
+    """One enabled size band for a code mode (access/codemode.go:24-45 analog)."""
+
+    mode: CodeMode
+    min_size: int = 0
+    max_size: int = 1 << 62
+
+
+def default_policies(az_count: int) -> list[CodeModePolicy]:
+    """Size-tiered, AZ-aware policy table. Small blobs favor low shard-count
+    modes (less per-shard overhead); large blobs favor wide stripes; clusters
+    with >=2 AZs put LRC modes on the live path so repairs stay AZ-local
+    (codemode.go:119-126)."""
+    K, M_ = 1024, 1024 * 1024
+    if az_count >= 3:
+        return [
+            CodeModePolicy(CodeMode.EC6P6, 0, 128 * K),
+            CodeModePolicy(CodeMode.EC12P9, 128 * K + 1, M_),
+            CodeModePolicy(CodeMode.EC6P3L3, M_ + 1),  # LRC archive tier
+        ]
+    if az_count == 2:
+        return [
+            CodeModePolicy(CodeMode.EC6P10L2, 0, M_),
+            CodeModePolicy(CodeMode.EC16P20L2, M_ + 1),  # LRC archive tier
+        ]
+    return [
+        CodeModePolicy(CodeMode.EC3P3, 0, 128 * K),
+        CodeModePolicy(CodeMode.EC6P3, 128 * K + 1, M_),
+        CodeModePolicy(CodeMode.EC12P4, M_ + 1),
+    ]
+
+
+def select_code_mode(size: int, policies: list[CodeModePolicy] | None = None) -> CodeMode:
+    """Policy-table code-mode choice (stream_put.go:64 SelectCodeMode analog)."""
+    for p in policies or default_policies(1):
+        if p.min_size <= size <= p.max_size:
+            return p.mode
+    raise AccessError(f"no code-mode policy covers size {size}")
 
 
 @dataclass
@@ -110,6 +140,7 @@ class Access:
         secret: bytes = b"chubaofs-tpu-location-secret",
         cluster_id: int = 1,
         max_workers: int = 16,
+        policies: list[CodeModePolicy] | None = None,
     ):
         self.cm = cm
         self.proxy = proxy
@@ -117,6 +148,10 @@ class Access:
         self.codec = codec or default_service()
         self.secret = secret
         self.cluster_id = cluster_id
+        if policies is None:
+            azs = {d.az for d in cm.disks.values()} or {0}
+            policies = default_policies(len(azs))
+        self.policies = policies
         self._pool = ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="access")
 
     # -- location signing ----------------------------------------------------
@@ -145,7 +180,11 @@ class Access:
     def _put(self, data: bytes, code_mode: CodeMode | int | None = None) -> Location:
         if not data:
             raise AccessError("empty put")
-        mode = int(code_mode) if code_mode is not None else int(select_code_mode(len(data)))
+        mode = (
+            int(code_mode)
+            if code_mode is not None
+            else int(select_code_mode(len(data), self.policies))
+        )
         loc = Location(cluster_id=self.cluster_id, code_mode=mode, size=len(data), crc=zlib.crc32(data))
 
         blobs = [data[i : i + MAX_BLOB_SIZE] for i in range(0, len(data), MAX_BLOB_SIZE)]
@@ -204,19 +243,39 @@ class Access:
         results = list(
             self._pool.map(lambda i: self._try(write_one, i), range(t.total))
         )
-        ok = [i for i, r in zip(range(t.total), results) if r is None]
-        failed = [i for i, r in zip(range(t.total), results) if r is not None]
-        if len(ok) < t.put_quorum:
+        ok = {i for i, r in zip(range(t.total), results) if r is None}
+        failed = sorted(set(range(t.total)) - ok)
+        # quorum counts global-stripe shards only (stream_put.go:226,362:
+        # maxWrittenIndex = N+M — local parities never satisfy the quorum)
+        written = len([i for i in ok if i < t.global_count])
+        if written < t.put_quorum and not self._one_dark_az(t, ok):
             from chubaofs_tpu.blobstore.blobnode import ChunkFull
 
             if any(isinstance(r, ChunkFull) for r in results):
                 raise VolumeFullError(f"volume {vol.vid} chunks full")
             raise QuorumError(
-                f"wrote {len(ok)}/{t.total} shards, quorum {t.put_quorum}; failures: {failed}"
+                f"wrote {written}/{t.global_count} global shards, quorum "
+                f"{t.put_quorum}; failures: {failed}"
             )
         if failed:
             # queue missing shards for background repair (stream_put.go:377-397)
             self.proxy.send_shard_repair(vol.vid, bid, failed, "put_failed")
+
+    @staticmethod
+    def _one_dark_az(t, ok: set[int]) -> bool:
+        """Tolerate exactly one fully-dark AZ at >=3 AZs, iff every other AZ is
+        fully written (stream_put.go:405-437)."""
+        if t.az_count < 3:
+            return False
+        all_fine = all_down = 0
+        for az in range(t.az_count):
+            idx = t.shards_in_az(az)
+            wrote = sum(1 for i in idx if i in ok)
+            if wrote == len(idx):
+                all_fine += 1
+            if wrote == 0:
+                all_down += 1
+        return all_fine == t.az_count - 1 and all_down == 1
 
     @staticmethod
     def _try(fn, *args):

@@ -272,16 +272,89 @@ class RepairWorker:
     def _repair_shards(self, vid: int, bid: int, bad_idx: list[int]):
         vol = self.cm.get_volume(vid)
         t = vol.tactic()
+        unhandled = sorted(set(bad_idx))
+        if t.L:
+            unhandled = self._repair_local_stripes(vol, t, bid, unhandled)
+            if not unhandled:
+                return
+        self._repair_global(vol, t, bid)
+
+    def _repair_local_stripes(self, vol: VolumeInfo, t, bid: int,
+                              bad_idx: list[int]) -> list[int]:
+        """LRC local-stripe-first repair (work_shard_recover.go:517
+        recoverByLocalStripe): for each AZ whose damage fits its local parity
+        budget, repair reading ONLY that AZ's shards. Returns the reported bad
+        indexes that still need the global path."""
+        leftover: list[int] = []
+        for idx, local_n, local_m in t.local_stripes():
+            az_reported = [i for i in bad_idx if i in idx]
+            if not az_reported:
+                continue
+            reads = self._probe(vol, bid, idx)  # same-AZ reads only
+            az_bad = [i for i in idx if i not in reads]
+            if not az_bad:
+                continue
+            if len(az_bad) > local_m:
+                leftover.extend(az_reported)  # beyond local budget
+                continue
+            shard_len = len(next(iter(reads.values())))
+            sub = np.zeros((len(idx), shard_len), np.uint8)
+            pos = {g: p for p, g in enumerate(idx)}
+            for g, data in reads.items():
+                sub[pos[g]] = np.frombuffer(data, np.uint8)
+            fixed = self.codec.reconstruct(
+                local_n, local_m, sub, [pos[i] for i in az_bad]
+            ).result()
+            for g in az_bad:
+                self._write_back(vol, g, bid, fixed[pos[g]].tobytes())
+        return leftover
+
+    def _repair_global(self, vol: VolumeInfo, t, bid: int):
+        """Global-stripe repair + recompute of any missing local parities."""
         stripe, present, shard_len = self._gather(vol, t, bid)
         missing = [i for i in range(t.N + t.M) if i not in present]
-        if not missing:
-            return
-        fixed = self.codec.reconstruct(t.N, t.M, stripe, missing).result()
-        for idx in missing:
+        if missing:
+            fixed = self.codec.reconstruct(t.N, t.M, stripe, missing).result()
+            for idx in missing:
+                self._write_back(vol, idx, bid, fixed[idx].tobytes())
+            stripe = fixed
+        if t.L:
+            # local parities live outside the global stripe: any missing one is
+            # recomputed from its AZ's (now whole) global shards
+            local_idx = list(range(t.global_count, t.total))
+            have = self._probe(vol, bid, local_idx)
+            lost_azs = {t.az_of_shard(i) for i in local_idx if i not in have}
+            local_n = (t.N + t.M) // t.az_count
+            local_m = t.L // t.az_count
+            for idx, _, _ in t.local_stripes():
+                az = t.az_of_shard(idx[0])
+                if az not in lost_azs:
+                    continue
+                src = stripe[idx[:local_n]]
+                full = self.codec.encode(local_n, local_m, src).result()
+                for p, g in enumerate(idx[local_n:]):
+                    if g not in have:
+                        self._write_back(vol, g, bid, full[local_n + p].tobytes())
+
+    def _write_back(self, vol: VolumeInfo, idx: int, bid: int, payload: bytes):
+        unit = vol.units[idx]
+        node = self.nodes[unit.node_id]
+        node.create_vuid(unit.vuid, unit.disk_id)
+        node.put_shard(unit.vuid, bid, payload)
+
+    def _probe(self, vol: VolumeInfo, bid: int, idxs) -> dict[int, bytes]:
+        """Read the given stripe positions; absent/unreachable ones are omitted."""
+        reads: dict[int, bytes] = {}
+        for idx in idxs:
             unit = vol.units[idx]
-            node = self.nodes[unit.node_id]
-            node.create_vuid(unit.vuid, unit.disk_id)
-            node.put_shard(unit.vuid, bid, fixed[idx].tobytes())
+            node = self.nodes.get(unit.node_id)
+            if node is None:
+                continue
+            try:
+                reads[idx] = node.get_shard(unit.vuid, bid)
+            except Exception:
+                continue
+        return reads
 
     def _gather(self, vol: VolumeInfo, t, bid: int):
         """Read every readable global shard of a stripe; infer shard_len."""
@@ -341,13 +414,28 @@ class RepairWorker:
                     except Exception:
                         pass  # fall through to reconstruct
                 stripe, present, _ = self._gather(vol, t, bid)
+                missing = [i for i in range(t.N + t.M) if i not in present]
                 if unit.index in present:
                     rows[bid] = stripe[unit.index].tobytes()
-                else:
+                elif unit.index < t.global_count:
                     # repair with the FULL missing set: zero-filled absent rows
                     # must never be treated as survivors
-                    missing = [i for i in range(t.N + t.M) if i not in present]
                     futures[bid] = self.codec.reconstruct(t.N, t.M, stripe, missing)
+                else:
+                    # LRC local parity: complete the globals, then re-encode
+                    # this AZ's local stripe to regenerate the lost row
+                    if missing:
+                        stripe = self.codec.reconstruct(t.N, t.M, stripe, missing).result()
+                    local_n = (t.N + t.M) // t.az_count
+                    local_m = t.L // t.az_count
+                    for idx, _, _ in t.local_stripes():
+                        if unit.index in idx:
+                            full = self.codec.encode(
+                                local_n, local_m, stripe[idx[:local_n]]
+                            ).result()
+                            pos = idx[local_n:].index(unit.index)
+                            rows[bid] = full[local_n + pos].tobytes()
+                            break
             for bid, fut in futures.items():
                 rows[bid] = fut.result()[unit.index].tobytes()
 

@@ -94,6 +94,14 @@ def gf_matmul_bytes_fused(
 
     mat_pm = mat_bits[jnp.asarray(_perm(r))][:, jnp.asarray(_perm(n))]
 
+    # Mosaic pads sub-tile sublane counts up to full int8 tiles (32 sublanes),
+    # so with few shard rows the unpack intermediates cost ~8*32 bytes/column
+    # regardless of n and the scoped-VMEM stack blows the 16M limit at large
+    # tiles (measured: n=3, r=1 at kt=128K needs 30.8M). Narrow tiles keep the
+    # stack bounded; wide stripes keep the measured-fast 128K tile.
+    if min(n, r) < 8:
+        tile_k = min(tile_k, 32768)
+
     b = 1
     for d in lead:
         b *= d
