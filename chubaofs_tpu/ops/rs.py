@@ -158,8 +158,29 @@ class RSKernel:
         closing over a plan inside jit never commits to the default device.
         """
         mat, present, missing = self.repair_matrix(bad_idx, data_only)
+        return self._device_plan(mat, present, missing)
+
+    @staticmethod
+    def _device_plan(mat, present, missing):
         mat_bits = bitmatrix.expand_matrix(mat).astype(np.int8)
         return mat_bits, np.asarray(present, np.int32), np.asarray(missing, np.int32)
+
+    def repair_plan_padded(self, bad_idx: list[int], data_only: bool = False):
+        """Fixed-shape repair plan: always m repair rows, so ONE compiled step
+        serves every missing pattern as runtime data — changing the set of
+        missing shards never recompiles (the static-shape discipline the
+        sharded codec step needs). Padded slots carry the GF identity row of
+        survivor 0 and target survivor 0's own position: a value-level no-op
+        write. Returns (repair_bits (8m, 8n) int8, present (n,), missing (m,)).
+        """
+        mat, present, missing = self.repair_matrix(bad_idx, data_only)
+        pad = self.m - len(missing)
+        if pad:
+            id_rows = np.zeros((pad, self.n), np.uint8)
+            id_rows[:, 0] = 1  # GF row e_0: recomputes survivor 0 exactly
+            mat = np.concatenate([mat, id_rows], axis=0) if len(missing) else id_rows
+            missing = list(missing) + [present[0]] * pad
+        return self._device_plan(mat, present, missing)
 
     def apply_repair(self, plan, shards: jax.Array, *, portable: bool = False) -> jax.Array:
         """Apply a repair_plan to (..., n+m, k) shards (jit-friendly)."""
