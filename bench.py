@@ -1,9 +1,19 @@
-"""Benchmark: EC(12,4) 8 MiB-stripe encode throughput on one TPU chip.
+"""Benchmark: all five BASELINE.json EC configs on one TPU chip.
 
-The headline metric of BASELINE.md's north star: GF(2^8) Reed-Solomon encode
-expressed as an int8 bit-matrix matmul on the MXU (fused Pallas kernel), target
->= 40 GB/s/chip on v5e-1 (vs_baseline is value/40.0). Prints exactly ONE JSON
-line on stdout; diagnostics go to stderr.
+Headline metric (north star): EC(12,4) 8 MiB-stripe encode, target >= 40 GB/s
+per chip on v5e-1 (vs_baseline = value/40). The other four configs from
+BASELINE.json ride along in the same JSON line:
+
+  * EC(4,2)  1 MiB stripe  — unit-bench config
+  * EC(6,3)  4 MiB stripe  — access PUT-path streaming encode
+  * EC(12,4) 8 MiB stripe  — encode + single-missing reconstruct
+  * EC(12,4) 8 MiB stripe, 3 missing, bulk repair — stripes/sec (the
+    scheduler's 10k-stripe migrate workload, measured as sustained device
+    rate on resident batches; see PERF.md for the traffic accounting)
+  * EC(20,4)+L2 16 MiB stripe — LRC archive config: global + per-AZ local
+    parity encode in one jitted step
+
+Prints exactly ONE JSON line on stdout; diagnostics go to stderr.
 
 Methodology: inputs resident in HBM; SLOPE timing — run N1 then N2 pipelined
 iterations each ended by a tiny host readback (the only reliable sync point
@@ -24,18 +34,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from chubaofs_tpu.models import FLAGSHIP
+from chubaofs_tpu.codec.codemode import Tactic
 from chubaofs_tpu.ops import rs
 
 TARGET_GBPS = 40.0
-BATCH = 16  # stripes per device call (16 x ~8 MiB data per step)
 
 
 def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def throughput_gbps(fn, args, payload_bytes, n1=10, n2=40, runs=3) -> float:
+def throughput(fn, args, n1=10, n2=40, runs=3) -> float:
+    """Seconds per call via slope timing (see module docstring)."""
+
     def timed(iters: int) -> float:
         t0 = time.perf_counter()
         out = None
@@ -51,45 +62,106 @@ def throughput_gbps(fn, args, payload_bytes, n1=10, n2=40, runs=3) -> float:
     per_iter = deltas[len(deltas) // 2] / (n2 - n1)
     if per_iter <= 0:
         raise RuntimeError(f"unstable timing: deltas={deltas}")
-    return payload_bytes / per_iter / 1e9
+    return per_iter
+
+
+def make_data(rng, dev, batch, n, k):
+    return jax.device_put(
+        jnp.asarray(rng.integers(0, 256, (batch, n, k), dtype=np.uint8)), dev
+    )
+
+
+def bench_encode(rng, dev, n, m, stripe_bytes, batch) -> float:
+    """Encode GB/s (payload basis) for one (n, m, stripe) config."""
+    k = -(-stripe_bytes // n // 128) * 128  # 128-aligned shard length
+    kernel = rs.get_kernel(n, m)
+    data = make_data(rng, dev, batch, n, k)
+    per = throughput(jax.jit(kernel.encode_parity), (data,))
+    return batch * n * k / per / 1e9
+
+
+def bench_reconstruct(rng, dev, n, m, stripe_bytes, batch, missing) -> tuple[float, float]:
+    """(GB/s payload basis, stripes/sec) repairing `missing` shards per stripe,
+    the blobnode-repair way: survivors in, missing rows out."""
+    k = -(-stripe_bytes // n // 128) * 128
+    kernel = rs.get_kernel(n, m)
+    mat_bits, present, _ = kernel.repair_plan(list(missing))
+    mat_bits = jax.device_put(jnp.asarray(mat_bits), dev)
+    data = make_data(rng, dev, batch, n, k)
+    stripe = jax.jit(kernel.encode)(data)
+    survivors = jax.jit(lambda s: jnp.take(s, present, axis=-2))(stripe)
+    np.asarray(survivors[..., :1])
+    per = throughput(jax.jit(rs.gf_matmul_dispatch), (mat_bits, survivors))
+    return batch * n * k / per / 1e9, batch / per
+
+
+def bench_lrc_encode(rng, dev, stripe_bytes, batch) -> float:
+    """EC(20,4)+L2 archive config: ALL parity (4 global + 2 per-AZ local) in
+    one composed-generator matmul (encoder.lrc_parity_matrix) — the TPU-first
+    replacement for the reference's two-stage global+local encode."""
+    from chubaofs_tpu.codec.encoder import lrc_parity_matrix
+    from chubaofs_tpu.ops import bitmatrix
+
+    t = Tactic(20, 4, 2, 2, put_quorum=22)
+    k = -(-stripe_bytes // t.N // 128) * 128
+    mat_bits = jax.device_put(
+        jnp.asarray(bitmatrix.expand_matrix(lrc_parity_matrix(t)).astype(np.int8)),
+        dev,
+    )
+    data = make_data(rng, dev, batch, t.N, k)
+    per = throughput(jax.jit(rs.gf_matmul_dispatch), (mat_bits, data))
+    return batch * t.N * k / per / 1e9
 
 
 def main() -> None:
-    t = FLAGSHIP.tactic
-    n, m, k = t.N, t.M, FLAGSHIP.shard_len
-    kernel = rs.get_kernel(n, m)
     dev = jax.devices()[0]
-    log(f"device={dev} layout=EC({n},{m}) shard_len={k} batch={BATCH}")
-
+    log(f"device={dev}")
     rng = np.random.default_rng(0)
-    data = jax.device_put(
-        jnp.asarray(rng.integers(0, 256, (BATCH, n, k), dtype=np.uint8)), dev
+    MiB = 1 << 20
+
+    cfg: dict[str, float] = {}
+
+    cfg["ec4p2_encode_1mib_gbps"] = round(
+        bench_encode(rng, dev, 4, 2, 1 * MiB, batch=64), 3
     )
-    payload = BATCH * n * k
+    log(f"EC(4,2) 1MiB encode: {cfg['ec4p2_encode_1mib_gbps']} GB/s")
 
-    encode = jax.jit(kernel.encode_parity)
-    gbps = throughput_gbps(encode, (data,), payload)
-    log(f"encode: {gbps:.2f} GB/s")
+    cfg["ec6p3_encode_4mib_gbps"] = round(
+        bench_encode(rng, dev, 6, 3, 4 * MiB, batch=24), 3
+    )
+    log(f"EC(6,3) 4MiB encode: {cfg['ec6p3_encode_4mib_gbps']} GB/s")
 
-    # reconstruct the blobnode-repair way: survivors in, missing rows out
-    # (1 missing data shard; target 25 GB/s)
-    mat_bits, present, _ = kernel.repair_plan([0])
-    mat_bits = jax.device_put(jnp.asarray(mat_bits), dev)  # repair plans are numpy; pin on-device before timing
-    stripe = jax.jit(kernel.encode)(data)
-    survivors = jax.jit(lambda s: jnp.take(s, present, axis=-2))(stripe)
-    survivors.block_until_ready()
-    rec = jax.jit(rs.gf_matmul_dispatch)
-    rec_gbps = throughput_gbps(rec, (mat_bits, survivors), payload)
-    log(f"reconstruct(1 data shard): {rec_gbps:.2f} GB/s")
+    headline = bench_encode(rng, dev, 12, 4, 8 * MiB, batch=16)
+    cfg["ec12p4_encode_8mib_gbps"] = round(headline, 3)
+    log(f"EC(12,4) 8MiB encode: {headline:.2f} GB/s")
+
+    rec_gbps, _ = bench_reconstruct(rng, dev, 12, 4, 8 * MiB, batch=16, missing=[0])
+    cfg["ec12p4_reconstruct_1miss_gbps"] = round(rec_gbps, 3)
+    log(f"EC(12,4) reconstruct(1 missing): {rec_gbps:.2f} GB/s")
+
+    bulk_gbps, stripes_sec = bench_reconstruct(
+        rng, dev, 12, 4, 8 * MiB, batch=64, missing=[0, 5, 12]
+    )
+    cfg["ec12p4_bulk_repair_3miss_stripes_per_sec"] = round(stripes_sec, 1)
+    cfg["ec12p4_bulk_repair_3miss_gbps"] = round(bulk_gbps, 3)
+    log(
+        f"EC(12,4) bulk repair (3 missing, 64-stripe device batches): "
+        f"{stripes_sec:.0f} stripes/s ({bulk_gbps:.2f} GB/s)"
+    )
+
+    cfg["ec20p4l2_encode_16mib_gbps"] = round(
+        bench_lrc_encode(rng, dev, 16 * MiB, batch=8), 3
+    )
+    log(f"EC(20,4)+L2 16MiB encode: {cfg['ec20p4l2_encode_16mib_gbps']} GB/s")
 
     print(
         json.dumps(
             {
                 "metric": "ec12p4_encode_8mib_stripe",
-                "value": round(gbps, 3),
+                "value": cfg["ec12p4_encode_8mib_gbps"],
                 "unit": "GB/s",
-                "vs_baseline": round(gbps / TARGET_GBPS, 4),
-                "reconstruct_1shard_gbps": round(rec_gbps, 3),
+                "vs_baseline": round(headline / TARGET_GBPS, 4),
+                "configs": cfg,
                 "device": str(dev),
             }
         )

@@ -201,13 +201,12 @@ class Access:
             mat = np.zeros((t.N, shard_len), np.uint8)
             flat = mat.reshape(-1)
             flat[: len(blob)] = np.frombuffer(blob, np.uint8)
-            futures.append(self.codec.encode(t.N, t.M, mat))
+            # one composed-matrix device pass yields global AND local parity
+            futures.append(self.codec.encode_tactic(t, mat))
             metas.append((first_bid + i, vol, len(blob)))
 
         for fut, (bid, vol, size) in zip(futures, metas):
-            stripe = fut.result()  # (N+M, shard_len)
-            if t.L:
-                stripe = self._append_local_parity(t, stripe)
+            stripe = fut.result()  # (total, shard_len), locals included
             try:
                 self._write_stripe(t, vol, bid, stripe)
             except VolumeFullError:
@@ -220,17 +219,6 @@ class Access:
 
         loc.signature = self._sign(loc)
         return loc
-
-    def _append_local_parity(self, t, stripe: np.ndarray) -> np.ndarray:
-        local_n = (t.N + t.M) // t.az_count
-        local_m = t.L // t.az_count
-        full = np.zeros((t.total, stripe.shape[1]), np.uint8)
-        full[: t.N + t.M] = stripe
-        src = np.stack([full[idx[:local_n]] for idx, _, _ in t.local_stripes()])
-        outs = [self.codec.encode(local_n, local_m, src[a]) for a in range(t.az_count)]
-        for a, (idx, _, _) in enumerate(t.local_stripes()):
-            full[idx[local_n:]] = outs[a].result()[local_n:]
-        return full
 
     def _write_stripe(self, t, vol: VolumeInfo, bid: int, stripe: np.ndarray):
         def write_one(idx: int):

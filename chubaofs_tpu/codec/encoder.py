@@ -21,13 +21,14 @@ The list-of-buffers API is kept for drop-in familiarity: a user of the reference
 
 from __future__ import annotations
 
+import functools
 from dataclasses import dataclass, field
 from typing import IO, Sequence
 
 import numpy as np
 
 from chubaofs_tpu.codec.codemode import CodeMode, Tactic, get_tactic
-from chubaofs_tpu.ops import rs
+from chubaofs_tpu.ops import gf256, rs
 
 Shards = list[np.ndarray]
 
@@ -284,6 +285,36 @@ class LrcEncoder(RsEncoder):
     def get_local_shards(self, shards: Sequence) -> list:
         t = self.tactic
         return list(shards[t.global_count : t.total])
+
+
+@functools.lru_cache(maxsize=32)
+def lrc_parity_matrix(t: Tactic) -> np.ndarray:
+    """Composed (M+L, N) GF(2^8) generator: global parity rows plus every AZ's
+    local parities expressed directly over the N data shards.
+
+    Every LRC parity is linear over GF(2^8), so the reference's two-stage
+    encode (global RS, then per-AZ local RS over data+parity rows —
+    lrcencoder.go) composes into ONE matrix: an AZ's local input rows are
+    A = [basis rows of its data shards; its global parity rows P_az], and its
+    local parity rows are L @ A. One fused matmul then yields ALL parity of an
+    LRC stripe — no intermediate stripe materialization, no second HBM pass.
+    The result is bit-identical to the two-stage path (same generators).
+    """
+    if not t.L:
+        raise ValueError("lrc_parity_matrix requires L != 0")
+    g = gf256.systematic_generator(t.N, t.M)  # (N+M, N)
+    P = g[t.N :]
+    local_n = (t.N + t.M) // t.az_count
+    local_m = t.L // t.az_count
+    L = gf256.systematic_generator(local_n, local_m)[local_n:]  # (local_m, local_n)
+    ident = np.eye(t.N, dtype=np.uint8)
+    rows = [P]
+    for idx, _, _ in t.local_stripes():
+        A = np.stack(
+            [ident[i] if i < t.N else P[i - t.N] for i in idx[:local_n]]
+        )  # (local_n, N)
+        rows.append(gf256.gf_matmul(L, A))
+    return np.concatenate(rows, axis=0)
 
 
 # the reference interface name, for drop-in reading of call sites

@@ -84,6 +84,34 @@ class CodecService:
         self._submit(job)
         return job.future
 
+    def encode_tactic(self, t, data: np.ndarray) -> Future:
+        """data (N, k) uint8 -> Future[(total, k) full stripe], local parities
+        included for LRC tactics — computed in ONE composed-matrix matmul
+        (encoder.lrc_parity_matrix), not a second device pass."""
+        if not t.L:
+            return self.encode(t.N, t.M, data)
+        from chubaofs_tpu.codec.encoder import lrc_parity_matrix
+
+        if data.shape[0] != t.N:
+            raise ValueError(f"want {t.N} data rows, got {data.shape}")
+        # normalize ONCE and build the result from the same snapshot the job
+        # computed parity from (caller-side dtype or mutation races otherwise
+        # yield a stripe whose data rows don't match its parity)
+        data = np.ascontiguousarray(data, np.uint8)
+        mat = lrc_parity_matrix(t)
+        job = _Job("matmul", t.N, t.M + t.L, data, data.shape[1], mat=mat)
+        self._submit(job)
+        out: Future = Future()
+
+        def _finish(f: Future):
+            if f.exception():
+                out.set_exception(f.exception())
+                return
+            out.set_result(np.concatenate([data, f.result()], axis=0))
+
+        job.future.add_done_callback(_finish)
+        return out
+
     def reconstruct(
         self, n: int, m: int, shards: np.ndarray, bad_idx: list[int], data_only=False
     ) -> Future:

@@ -126,11 +126,13 @@ def sharded_codec_step(
 
     @functools.lru_cache(maxsize=64)
     def plan_for(bad: tuple) -> tuple:
-        # the O(n^3) host-side inversion runs once per pattern, not per step
-        return kernel.repair_plan_padded(list(bad))
+        # once per pattern: the O(n^3) host-side inversion AND the replicated
+        # broadcast to every mesh device (repeat steps transfer nothing)
+        plan = kernel.repair_plan_padded(list(bad))
+        return tuple(jax.device_put(a, replicated) for a in plan)
 
     def run(data, bad_idx=(0, n)):
-        plan = plan_for(tuple(sorted(set(int(i) for i in bad_idx))))
+        args = plan_for(tuple(sorted(set(int(i) for i in bad_idx))))
         if not isinstance(data, jax.Array):
             data = np.asarray(data)
         b = data.shape[0]
@@ -142,7 +144,6 @@ def sharded_codec_step(
                 [data, xp.zeros((pad, *data.shape[1:]), xp.uint8)], axis=0
             )
         data = shard_stripes(mesh, data)
-        args = tuple(jax.device_put(a, replicated) for a in plan)
         with mesh:
             out = jitted(data, *args)
         if pad:

@@ -174,3 +174,42 @@ def test_readonly_shards_rejected_before_compute():
         enc.encode(shards)
     with pytest.raises(InvalidShardsError, match="read-only"):
         enc.reconstruct(shards, [0])
+
+
+@pytest.mark.parametrize("mode", [CodeMode.EC4P4L2, CodeMode.EC6P10L2,
+                                  CodeMode.EC6P3L3, CodeMode.EC16P20L2])
+def test_lrc_composed_parity_matrix_matches_two_stage(rng, mode):
+    """The single composed-generator matmul (lrc_parity_matrix) is bit-identical
+    to the two-stage global+local encode for every LRC tactic."""
+    from chubaofs_tpu.codec.codemode import get_tactic
+    from chubaofs_tpu.codec.encoder import lrc_parity_matrix
+    from chubaofs_tpu.ops import gf256
+
+    t = get_tactic(mode)
+    enc = new_encoder(mode)
+    data = rng.integers(0, 256, t.N * 512, dtype=np.uint8).tobytes()
+    shards = enc.split(data)
+    enc.encode(shards)  # two-stage reference result
+
+    mat = lrc_parity_matrix(t)
+    assert mat.shape == (t.M + t.L, t.N)
+    parity = gf256.gf_matmul(mat, np.stack(shards[: t.N]))
+    np.testing.assert_array_equal(parity, np.stack(shards[t.N :]))
+
+
+def test_encode_tactic_service_lrc(rng):
+    """CodecService.encode_tactic returns a full LRC stripe that the LrcEncoder
+    verifies (globals AND local stripes)."""
+    from chubaofs_tpu.codec.codemode import get_tactic
+    from chubaofs_tpu.codec.service import CodecService
+
+    t = get_tactic(CodeMode.EC6P3L3)
+    svc = CodecService()
+    try:
+        data = rng.integers(0, 256, (t.N, 4096), dtype=np.uint8)
+        stripe = svc.encode_tactic(t, data).result()
+        assert stripe.shape == (t.total, 4096)
+        enc = new_encoder(CodeMode.EC6P3L3)
+        assert enc.verify(list(stripe))
+    finally:
+        svc.close()
