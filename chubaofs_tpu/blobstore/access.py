@@ -22,8 +22,11 @@ from __future__ import annotations
 import hashlib
 import hmac
 import json
+import threading
+import time
 import zlib
 from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -51,6 +54,12 @@ class VolumeFullError(AccessError):
 
 class LocationError(AccessError):
     pass
+
+
+class DiskPunished(AccessError):
+    """Disk is in its punish window after repeated errors/timeouts — writes
+    fail fast instead of queueing behind a wedged device (stream_put.go:303-340
+    punishDisk analog)."""
 
 
 @dataclass(frozen=True)
@@ -141,6 +150,10 @@ class Access:
         cluster_id: int = 1,
         max_workers: int = 16,
         policies: list[CodeModePolicy] | None = None,
+        per_disk_cap: int = 4,
+        write_deadline: float = 10.0,
+        punish_secs: float = 30.0,
+        qos=None,
     ):
         self.cm = cm
         self.proxy = proxy
@@ -152,7 +165,37 @@ class Access:
             azs = {d.az for d in cm.disks.values()} or {0}
             policies = default_policies(len(azs))
         self.policies = policies
+        # failure containment (stream_put.go:303-351): bounded in-flight writes
+        # per disk, a hard deadline per stripe write, and a punish window after
+        # errors so one wedged blobnode can't exhaust the pool or stall
+        # unrelated PUTs
+        self.per_disk_cap = per_disk_cap
+        self.write_deadline = write_deadline
+        self.punish_secs = punish_secs
+        self.qos = qos  # optional utils.ratelimit.KeyedLimiter ("put"/"get" bytes)
+        self.qos_timeout = 30.0  # max throttle wait before failing the request
+        self._disk_sems: dict[int, threading.Semaphore] = {}
+        self._punished: dict[int, float] = {}
+        self._punish_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="access")
+
+    # -- failure containment --------------------------------------------------
+
+    def _sem(self, disk_id: int) -> threading.Semaphore:
+        with self._punish_lock:
+            sem = self._disk_sems.get(disk_id)
+            if sem is None:
+                sem = threading.Semaphore(self.per_disk_cap)
+                self._disk_sems[disk_id] = sem
+            return sem
+
+    def _is_punished(self, disk_id: int) -> bool:
+        with self._punish_lock:
+            return self._punished.get(disk_id, 0.0) > time.monotonic()
+
+    def punish_disk(self, disk_id: int, reason: str = "") -> None:
+        with self._punish_lock:
+            self._punished[disk_id] = time.monotonic() + self.punish_secs
 
     # -- location signing ----------------------------------------------------
 
@@ -171,6 +214,8 @@ class Access:
     def put(self, data: bytes, code_mode: CodeMode | int | None = None) -> Location:
         from chubaofs_tpu.blobstore import trace
 
+        if self.qos is not None and not self.qos.wait("put", len(data), timeout=self.qos_timeout):
+            raise AccessError("put bandwidth limit exceeded")
         with trace.child_of(trace.current_span(), "access.put") as span:
             span.set_tag("size", len(data))
             loc = self._put(data, code_mode)
@@ -221,24 +266,56 @@ class Access:
         return loc
 
     def _write_stripe(self, t, vol: VolumeInfo, bid: int, stripe: np.ndarray):
+        from chubaofs_tpu.blobstore.blobnode import ChunkFull
+
+        deadline = time.monotonic() + self.write_deadline
+        started = [False] * t.total
+
         def write_one(idx: int):
+            started[idx] = True
             unit = vol.units[idx]
+            if self._is_punished(unit.disk_id):
+                raise DiskPunished(f"disk {unit.disk_id} punished")
             node = self.nodes[unit.node_id]
-            node.create_vuid(unit.vuid, unit.disk_id)
-            node.put_shard(unit.vuid, bid, stripe[idx].tobytes())
+            sem = self._sem(unit.disk_id)
+            budget = deadline - time.monotonic()
+            if budget <= 0 or not sem.acquire(timeout=budget):
+                # concurrency cap exhausted within the deadline: the disk is
+                # wedged — punish it so later PUTs fail fast
+                self.punish_disk(unit.disk_id, "cap_exhausted")
+                raise DiskPunished(f"disk {unit.disk_id} at concurrency cap")
+            try:
+                node.create_vuid(unit.vuid, unit.disk_id)
+                node.put_shard(unit.vuid, bid, stripe[idx].tobytes())
+            except ChunkFull:
+                raise  # full != broken: rotate the volume, don't punish
+            except Exception:
+                self.punish_disk(unit.disk_id, "error")
+                raise
+            finally:
+                sem.release()
             return idx
 
-        results = list(
-            self._pool.map(lambda i: self._try(write_one, i), range(t.total))
-        )
+        futs = [self._pool.submit(self._try, write_one, i) for i in range(t.total)]
+        results = []
+        for idx, f in enumerate(futs):
+            budget = deadline + 0.25 - time.monotonic()  # workers self-deadline
+            try:
+                results.append(f.result(timeout=max(0.01, budget)))
+            except FutureTimeout:
+                # a RUNNING write that outlives the deadline is the wedged-disk
+                # signal (stream_put.go:343-346 punishDiskWith on timeout); a
+                # task still queued behind a busy pool says nothing about its
+                # disk — punishing it would blacklist healthy devices
+                if started[idx]:
+                    self.punish_disk(vol.units[idx].disk_id, "timeout")
+                results.append(TimeoutError("stripe write deadline"))
         ok = {i for i, r in zip(range(t.total), results) if r is None}
         failed = sorted(set(range(t.total)) - ok)
         # quorum counts global-stripe shards only (stream_put.go:226,362:
         # maxWrittenIndex = N+M — local parities never satisfy the quorum)
         written = len([i for i in ok if i < t.global_count])
         if written < t.put_quorum and not self._one_dark_az(t, ok):
-            from chubaofs_tpu.blobstore.blobnode import ChunkFull
-
             if any(isinstance(r, ChunkFull) for r in results):
                 raise VolumeFullError(f"volume {vol.vid} chunks full")
             raise QuorumError(
@@ -278,6 +355,13 @@ class Access:
     def get(self, loc: Location | str, offset: int = 0, size: int | None = None) -> bytes:
         from chubaofs_tpu.blobstore import trace
 
+        if isinstance(loc, str):
+            loc = Location.from_json(loc)
+        if self.qos is not None:
+            # charge the real read size: a default full-object get is loc.size
+            want = size if size is not None else max(0, loc.size - offset)
+            if not self.qos.wait("get", max(1, want), timeout=self.qos_timeout):
+                raise AccessError("get bandwidth limit exceeded")
         with trace.child_of(trace.current_span(), "access.get") as span:
             data = self._get(loc, offset, size)
             span.append_track_log("access")

@@ -334,6 +334,7 @@ class MetaNodeDaemon(_Daemon):
         self.raft = MultiRaft(self.node_id, self.net, wal_dir=cfg.get("walDir"),
                               snapshot_every=512)
         self.metanode = MetaNode(self.node_id, self.raft)
+        self.zone = cfg.get("zone", "")
         host, port = _addr_split(cfg.get("listen", "127.0.0.1:0"))
         self.service = MetaService(self.metanode, host=host, port=port)
         self.addr = _advertise(self.service.addr, cfg)
@@ -405,7 +406,7 @@ class MetaNodeDaemon(_Daemon):
 
     def _register(self):
         self.mc.add_node(self.node_id, "meta", self.addr,
-                         raft_addr=self._raft_addr)
+                         raft_addr=self._raft_addr, zone=self.zone)
 
     def _heartbeat(self):
         from chubaofs_tpu.master.master import MasterError
@@ -482,6 +483,7 @@ class DataNodeDaemon(_Daemon):
                               snapshot_every=512)
         self.datanode = DataNode(self.node_id, cfg.get("listen", "127.0.0.1:0"),
                                  cfg["disks"], raft=self.raft)
+        self.zone = cfg.get("zone", "")
         self.datanode.start()
         self.addr = _advertise(self.datanode.addr, cfg)
         self.mc = MasterClient(cfg["masterAddrs"])
@@ -497,7 +499,7 @@ class DataNodeDaemon(_Daemon):
 
     def _register(self):
         self.mc.add_node(self.node_id, "data", self.addr,
-                         raft_addr=self._raft_addr)
+                         raft_addr=self._raft_addr, zone=self.zone)
 
     def _heartbeat(self):
         from chubaofs_tpu.master.master import MasterError

@@ -94,10 +94,11 @@ class CodecService:
 
         if data.shape[0] != t.N:
             raise ValueError(f"want {t.N} data rows, got {data.shape}")
-        # normalize ONCE and build the result from the same snapshot the job
-        # computed parity from (caller-side dtype or mutation races otherwise
-        # yield a stripe whose data rows don't match its parity)
-        data = np.ascontiguousarray(data, np.uint8)
+        # snapshot ONCE (explicit copy) and build the result from the same
+        # snapshot the job computed parity from — caller-side dtype changes or
+        # post-submit mutation must never yield a stripe whose data rows don't
+        # match its parity
+        data = np.array(data, np.uint8, order="C")
         mat = lrc_parity_matrix(t)
         job = _Job("matmul", t.N, t.M + t.L, data, data.shape[1], mat=mat)
         self._submit(job)

@@ -23,6 +23,7 @@ from chubaofs_tpu.rpc.server import RPCServer
 CODE_OK = 0
 CODE_ERR = 1
 CODE_NOT_LEADER = 2
+CODE_BUSY = 3  # QoS limit hit; clients back off and retry (master/limiter.go)
 
 
 def envelope(data=None, code: int = CODE_OK, msg: str = "success") -> dict:
@@ -33,15 +34,19 @@ class MasterAPI:
     """HTTP service bound to one master replica."""
 
     def __init__(self, master: Master, leader_addr_of=None,
-                 service_secret: bytes | None = None):
+                 service_secret: bytes | None = None, qos=None):
         """leader_addr_of: node_id -> admin-API address, for leader redirects.
         service_secret gates the credential-bearing /user/akInfo endpoint
         (objectnode signs with it); without one, akInfo only answers loopback
         clients — S3 secrets must never be harvestable off the open admin API
-        (round-1 advisory)."""
+        (round-1 advisory). qos: a utils.ratelimit.KeyedLimiter with per-route
+        op limits (master/limiter.go analog); None = unlimited."""
+        from chubaofs_tpu.utils.ratelimit import KeyedLimiter
+
         self.master = master
         self.leader_addr_of = leader_addr_of or (lambda node_id: "")
         self.service_secret = service_secret
+        self.qos = qos if qos is not None else KeyedLimiter()
         self.router = self._build()
 
     # -- plumbing -------------------------------------------------------------
@@ -76,9 +81,12 @@ class MasterAPI:
         return r
 
     def _w(self, fn, leader: bool = True):
-        """Wrap a handler: leader gate + MasterError → envelope."""
+        """Wrap a handler: QoS gate + leader gate + MasterError → envelope."""
 
         def handler(req: Request):
+            if not self.qos.allow(req.path):
+                return Response.json(
+                    envelope(None, CODE_BUSY, "rate limit exceeded"), status=200)
             if leader and not self.master.is_leader:
                 lead = self.master.raft.leader_of(MASTER_GROUP)
                 addr = self.leader_addr_of(lead) if lead is not None else ""
@@ -157,7 +165,8 @@ class MasterAPI:
     def _add_node(self, req: Request, kind: str):
         node_id = int(req.q("id"))
         self.master.register_node(node_id, kind, req.q("addr"),
-                                  raft_addr=req.q("raftAddr"))
+                                  raft_addr=req.q("raftAddr"),
+                                  zone=req.q("zone"))
         return {"id": node_id}
 
     def add_node_data(self, req: Request):
@@ -320,10 +329,11 @@ class MasterClient:
     def meta_partitions(self, name: str):
         return self.call(self._path("/client/metaPartitions", name=name))
 
-    def add_node(self, node_id: int, kind: str, addr: str, raft_addr: str = ""):
+    def add_node(self, node_id: int, kind: str, addr: str, raft_addr: str = "",
+                 zone: str = ""):
         which = "dataNode" if kind == "data" else "metaNode"
         return self.call(self._path(f"/{which}/add", id=node_id, addr=addr,
-                                    raftAddr=raft_addr))
+                                    raftAddr=raft_addr, zone=zone))
 
     def heartbeat(self, node_id: int, partitions: int = 0, cursors: dict | None = None):
         import json

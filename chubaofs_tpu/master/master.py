@@ -24,6 +24,7 @@ MASTER_GROUP = 1
 META_RANGE_STEP = 1 << 24  # inos per partition before splitting
 SPLIT_HEADROOM = 1 << 20  # split when cursor is this close to the end
 INF = 1 << 63
+NODESET_CAPACITY = 18  # nodes per nodeset (master/topology.go default)
 
 
 class MasterError(Exception):
@@ -40,6 +41,8 @@ class NodeInfo:
     partition_count: int = 0
     cursors: dict[int, int] = field(default_factory=dict)  # pid -> cursor (meta)
     status: str = "active"  # active | decommissioned
+    zone: str = ""  # fault domain (master/topology.go:43 zones)
+    nodeset: int = 0  # zone-local nodeset index (bounded failure groups)
 
     @property
     def schedulable(self) -> bool:
@@ -168,12 +171,16 @@ class MasterSM(StateMachine):
         return self.next_id
 
     def _op_register_node(self, node_id: int, kind: str, addr: str,
-                          raft_addr: str = "", now: float = 0.0):
+                          raft_addr: str = "", now: float = 0.0,
+                          zone: str = ""):
         # `now` is stamped by the PROPOSER: calling time.time() inside apply
         # would make replicas and WAL replay record different values, so a
         # restarted master could trust dead nodes as freshly heartbeaten
         if node_id not in self.nodes:
-            self.nodes[node_id] = NodeInfo(node_id, kind, addr)
+            self.nodes[node_id] = NodeInfo(
+                node_id, kind, addr, zone=zone,
+                nodeset=self._assign_nodeset(kind, zone),
+            )
         n = self.nodes[node_id]
         if n.kind != kind:  # operator config error: one id, two roles
             raise MasterError(
@@ -182,8 +189,26 @@ class MasterSM(StateMachine):
             n.addr = addr
         if raft_addr:
             n.raft_addr = raft_addr
+        if zone and zone != n.zone:
+            # late-reported or operator-changed zone: re-home the nodeset too,
+            # or the capacity bound would silently break in the new zone
+            n.nodeset = self._assign_nodeset(kind, zone)
+            n.zone = zone
         n.last_heartbeat = max(n.last_heartbeat, now)
         return node_id
+
+    def _assign_nodeset(self, kind: str, zone: str) -> int:
+        """Smallest zone-local nodeset with spare capacity — deterministic over
+        replicated state, so every replica assigns identically
+        (master/topology.go nodeset grouping, capacity-bounded)."""
+        counts: dict[int, int] = {}
+        for n in self.nodes.values():
+            if n.kind == kind and n.zone == zone:
+                counts[n.nodeset] = counts.get(n.nodeset, 0) + 1
+        ns = 0
+        while counts.get(ns, 0) >= NODESET_CAPACITY:
+            ns += 1
+        return ns
 
     def _op_heartbeat(self, node_id: int, partition_count: int = 0,
                       cursors: dict | None = None, now: float = 0.0):
@@ -395,9 +420,19 @@ class Master:
     # -- node admin -----------------------------------------------------------
 
     def register_node(self, node_id: int, kind: str, addr: str = "",
-                      raft_addr: str = "") -> None:
+                      raft_addr: str = "", zone: str = "") -> None:
         self._apply("register_node", node_id=node_id, kind=kind, addr=addr,
-                    raft_addr=raft_addr, now=time.time())
+                    raft_addr=raft_addr, now=time.time(), zone=zone)
+
+    def topology(self) -> dict:
+        """zones -> nodesets -> node ids (master/topology.go view analog)."""
+        out: dict[str, dict[int, list[int]]] = {}
+        for n in self.sm.nodes.values():
+            out.setdefault(n.zone, {}).setdefault(n.nodeset, []).append(n.node_id)
+        for zone in out.values():
+            for ids in zone.values():
+                ids.sort()
+        return out
 
     def heartbeat(self, node_id: int, partition_count: int = 0, cursors: dict | None = None):
         self._apply("heartbeat", node_id=node_id, partition_count=partition_count,
@@ -405,25 +440,52 @@ class Master:
 
     # -- volume admin -----------------------------------------------------------
 
-    def _pick_meta_peers(self, count: int = 3, exclude: set[int] = frozenset()) -> list[int]:
-        metas = sorted(
-            (n for n in self.sm.nodes.values()
-             if n.kind == "meta" and n.schedulable and n.node_id not in exclude),
-            key=lambda n: n.partition_count,
-        )
-        if len(metas) < count:
-            raise MasterError(f"need {count} metanodes, have {len(metas)}")
-        return [n.node_id for n in metas[:count]]
+    def _spread_by_zone(self, cands: list[NodeInfo], count: int,
+                        kind: str, prefer_zone: str | None = None) -> list[NodeInfo]:
+        """Zone-aware replica spread (master/topology.go placement contract):
+        with >= `count` zones, one replica per zone; with fewer, round-robin so
+        no zone holds two replicas before every zone holds one. `prefer_zone`
+        biases single-node picks (decommission replacements stay in the
+        victim's zone to preserve the spread)."""
+        if len(cands) < count:
+            raise MasterError(f"need {count} {kind}nodes, have {len(cands)}")
+        by_zone: dict[str, list[NodeInfo]] = {}
+        for n in sorted(cands, key=lambda n: n.partition_count):
+            by_zone.setdefault(n.zone, []).append(n)
+        if prefer_zone is not None and count == 1 and by_zone.get(prefer_zone):
+            return [by_zone[prefer_zone][0]]
+        zones = sorted(by_zone.values(), key=lambda ns: ns[0].partition_count)
+        picked: list[NodeInfo] = []
+        if len(zones) >= count:
+            for ns in zones[:count]:
+                picked.append(ns[0])
+        else:
+            rank = 0
+            while len(picked) < count:
+                advanced = False
+                for ns in zones:
+                    if rank < len(ns):
+                        picked.append(ns[rank])
+                        advanced = True
+                        if len(picked) == count:
+                            break
+                if not advanced:
+                    raise MasterError(f"need {count} {kind}nodes, have {len(picked)}")
+                rank += 1
+        return picked
 
-    def _pick_data_peers(self, count: int = 3, exclude: set[int] = frozenset()) -> list[NodeInfo]:
-        datas = sorted(
-            (n for n in self.sm.nodes.values()
-             if n.kind == "data" and n.schedulable and n.node_id not in exclude),
-            key=lambda n: n.partition_count,
-        )
-        if len(datas) < count:
-            raise MasterError(f"need {count} datanodes, have {len(datas)}")
-        return datas[:count]
+    def _pick_meta_peers(self, count: int = 3, exclude: set[int] = frozenset(),
+                         prefer_zone: str | None = None) -> list[int]:
+        metas = [n for n in self.sm.nodes.values()
+                 if n.kind == "meta" and n.schedulable and n.node_id not in exclude]
+        return [n.node_id
+                for n in self._spread_by_zone(metas, count, "meta", prefer_zone)]
+
+    def _pick_data_peers(self, count: int = 3, exclude: set[int] = frozenset(),
+                         prefer_zone: str | None = None) -> list[NodeInfo]:
+        datas = [n for n in self.sm.nodes.values()
+                 if n.kind == "data" and n.schedulable and n.node_id not in exclude]
+        return self._spread_by_zone(datas, count, "data", prefer_zone)
 
     def create_volume(self, name: str, owner: str = "", capacity: int = 1 << 40,
                       cold: bool = False, data_partitions: int = 3) -> VolumeView:
@@ -555,7 +617,9 @@ class Master:
             for mp in vol.meta_partitions:
                 if node_id not in mp.peers:
                     continue
-                repl = self._pick_meta_peers(1, exclude=set(mp.peers))[0]
+                victim_zone = self.sm.nodes[node_id].zone
+                repl = self._pick_meta_peers(1, exclude=set(mp.peers),
+                                             prefer_zone=victim_zone)[0]
                 new_peers = [p for p in mp.peers if p != node_id] + [repl]
                 if self.metanode_hook:
                     # replacement-only create with the final membership
@@ -589,7 +653,9 @@ class Master:
             for dp in vol.data_partitions:
                 if node_id not in dp.peers:
                     continue
-                repl = self._pick_data_peers(1, exclude=set(dp.peers))[0]
+                repl = self._pick_data_peers(
+                    1, exclude=set(dp.peers),
+                    prefer_zone=self.sm.nodes[node_id].zone)[0]
                 idx = dp.peers.index(node_id)
                 new_peers = [p for p in dp.peers if p != node_id] + [repl.node_id]
                 hosts = self._current_hosts(dp.peers, dp.hosts)

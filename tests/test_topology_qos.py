@@ -1,0 +1,225 @@
+"""Master topology (zones/nodesets), zone-aware placement, and QoS.
+
+Reference: master/topology.go:43 (zones, capacity-bounded nodesets),
+replica placement never co-locating two replicas in one zone when >= 3 exist,
+master/limiter.go (per-API token buckets), blobstore/access/stream_put.go:303-351
+(per-disk punish + containment).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from chubaofs_tpu.blobstore.cluster import MiniCluster
+from chubaofs_tpu.master.master import (
+    MASTER_GROUP,
+    NODESET_CAPACITY,
+    Master,
+    MasterError,
+    MasterSM,
+)
+from chubaofs_tpu.raft.server import InProcNet, MultiRaft, run_until
+from chubaofs_tpu.utils.ratelimit import KeyedLimiter, RateLimitExceeded, TokenBucket
+
+
+@pytest.fixture
+def master(tmp_path):
+    net = InProcNet()
+    raft = MultiRaft(1, net, wal_dir=str(tmp_path / "m1"))
+    sm = MasterSM()
+    raft.create_group(MASTER_GROUP, [1], sm)
+    assert run_until(net, lambda: raft.is_leader(MASTER_GROUP))
+    return Master(raft, sm)
+
+
+def _register_grid(master, kind, zones, per_zone, base):
+    nid = base
+    for z in range(zones):
+        for _ in range(per_zone):
+            master.register_node(nid, kind, addr=f"h{nid}:1", zone=f"z{z}")
+            nid += 1
+
+
+def _zone_of(master, node_id):
+    return master.sm.nodes[node_id].zone
+
+
+# -- topology -----------------------------------------------------------------
+
+
+def test_nodeset_capacity_split(master):
+    for i in range(NODESET_CAPACITY + 2):
+        master.register_node(100 + i, "meta", zone="z0")
+    sets = {n.nodeset for n in master.sm.nodes.values()}
+    assert sets == {0, 1}
+    topo = master.topology()
+    assert len(topo["z0"][0]) == NODESET_CAPACITY
+    assert len(topo["z0"][1]) == 2
+
+
+def test_zone_spread_three_zones(master):
+    """With >= 3 zones, a 3-replica partition never puts two replicas in one
+    zone (master/topology.go placement contract)."""
+    _register_grid(master, "meta", zones=3, per_zone=2, base=100)
+    _register_grid(master, "data", zones=3, per_zone=2, base=200)
+    vol = master.create_volume("v1", data_partitions=4)
+    for mp in vol.meta_partitions:
+        zones = {_zone_of(master, p) for p in mp.peers}
+        assert len(zones) == 3, f"mp peers {mp.peers} span only {zones}"
+    for dp in vol.data_partitions:
+        zones = {_zone_of(master, p) for p in dp.peers}
+        assert len(zones) == 3, f"dp peers {dp.peers} span only {zones}"
+
+
+def test_zone_spread_two_zones_round_robin(master):
+    """Fewer zones than replicas: no zone holds two replicas before every zone
+    holds one (2 zones -> a 3-replica split of 2+1)."""
+    _register_grid(master, "meta", zones=2, per_zone=3, base=100)
+    vol = master.create_volume("v2", data_partitions=0, cold=True)
+    counts: dict[str, int] = {}
+    for p in vol.meta_partitions[0].peers:
+        z = _zone_of(master, p)
+        counts[z] = counts.get(z, 0) + 1
+    assert sorted(counts.values()) == [1, 2]
+
+
+def test_decommission_replacement_stays_in_zone(master):
+    _register_grid(master, "meta", zones=3, per_zone=2, base=100)
+    vol = master.create_volume("v3", data_partitions=0, cold=True)
+    victim = vol.meta_partitions[0].peers[0]
+    victim_zone = _zone_of(master, victim)
+    master.decommission_metanode(victim)
+    new_peers = master.sm.volumes["v3"].meta_partitions[0].peers
+    assert victim not in new_peers
+    zones = [_zone_of(master, p) for p in new_peers]
+    assert sorted(zones) == ["z0", "z1", "z2"], zones
+    assert victim_zone in zones
+
+
+def test_insufficient_nodes_error(master):
+    _register_grid(master, "meta", zones=1, per_zone=2, base=100)
+    with pytest.raises(MasterError, match="need 3"):
+        master.create_volume("v4", data_partitions=0, cold=True)
+
+
+# -- rate limiting primitives -------------------------------------------------
+
+
+def test_token_bucket_burst_and_refill():
+    b = TokenBucket(rate=100, burst=10)
+    assert b.try_acquire(10)
+    assert not b.try_acquire(1)  # drained
+    assert b.acquire(1, timeout=0.5)  # refills at 100/s -> ~10ms
+    assert not b.acquire(10, timeout=0.01)  # can't refill 10 in 10ms
+
+
+def test_token_bucket_unlimited():
+    b = TokenBucket(rate=0)
+    assert b.try_acquire(1e9)
+
+
+def test_keyed_limiter():
+    lim = KeyedLimiter({"op": (5, 2)})
+    assert lim.allow("op", 2)
+    assert not lim.allow("op", 2)
+    assert lim.allow("other")  # unknown keys unlimited by default
+    with pytest.raises(RateLimitExceeded):
+        lim.check("op", 2)
+    lim.set_rate("op", 1000, 1000)
+    assert lim.allow("op", 500)
+
+
+def test_master_api_qos_busy(master):
+    """A dry route bucket answers CODE_BUSY instead of doing work
+    (master/limiter.go behavior)."""
+    from chubaofs_tpu.master.api_service import CODE_BUSY, CODE_OK, MasterAPI
+    from chubaofs_tpu.rpc.router import Request
+
+    api = MasterAPI(master, qos=KeyedLimiter({"/admin/getCluster": (0.001, 1)}))
+
+    def req(path):
+        return Request(method="GET", path=path, query={}, headers={}, body=b"")
+
+    import json
+
+    r1 = json.loads(api.router.dispatch(req("/admin/getCluster")).body)
+    r2 = json.loads(api.router.dispatch(req("/admin/getCluster")).body)
+    assert r1["code"] == CODE_OK
+    assert r2["code"] == CODE_BUSY
+
+
+# -- blobstore containment ----------------------------------------------------
+
+
+class WedgedNode:
+    """A blobnode whose writes hang (wedged device); reads still work."""
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.unwedge = threading.Event()
+
+    def put_shard(self, vuid, bid, payload):
+        self.unwedge.wait(timeout=30)
+        if not self.unwedge.is_set():
+            raise RuntimeError("wedged")
+        return self._inner.put_shard(vuid, bid, payload)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def blob_bytes(rng, n):
+    return rng.integers(0, 256, n, dtype=np.uint8).tobytes()
+
+
+def test_wedged_node_does_not_stall_puts(tmp_path, rng):
+    """One wedged blobnode: the PUT touching it completes within the write
+    deadline via quorum, the wedged disk gets punished (later writes fail
+    fast), and unrelated PUTs are unaffected (stream_put.go:303-351)."""
+    c = MiniCluster(str(tmp_path), n_nodes=10, disks_per_node=1)
+    try:
+        c.access.write_deadline = 1.5
+        c.access.punish_secs = 30.0
+        # pre-create the EC6P3 volume so we can pick a node hosting ONE unit
+        vol = c.cm.alloc_volume(13)  # EC6P3: 9 units on 9 of 10 nodes
+        per_node: dict[int, int] = {}
+        for u in vol.units:
+            per_node[u.node_id] = per_node.get(u.node_id, 0) + 1
+        wedged_id = next(n for n, k in per_node.items() if k == 1)
+        wedged = WedgedNode(c.nodes[wedged_id])
+        c.nodes[wedged_id] = wedged
+
+        data = blob_bytes(rng, 600_000)  # selects EC6P3
+        t0 = time.monotonic()
+        loc = c.access.put(data)
+        first = time.monotonic() - t0
+        assert first < 5.0, f"PUT stalled {first:.1f}s behind the wedged node"
+        assert c.access.get(loc) == data
+
+        # wedged disk now punished: a second PUT fails that shard fast
+        t0 = time.monotonic()
+        loc2 = c.access.put(blob_bytes(rng, 600_000))
+        assert time.monotonic() - t0 < 1.0, "punished disk not failing fast"
+        assert c.access.get(loc2)
+
+        # failed shards rode the repair topic
+        assert c.proxy.topics["shard_repair"].lag("scheduler") > 0
+
+        wedged.unwedge.set()
+        c.nodes[wedged_id] = wedged._inner
+    finally:
+        c.close()
+
+
+def test_access_qos_bandwidth(tmp_path, rng):
+    c = MiniCluster(str(tmp_path), n_nodes=9, disks_per_node=2)
+    try:
+        c.access.qos = KeyedLimiter({"put": (1000.0, 200_000.0)})
+        c.access.qos_timeout = 0.05
+        assert c.access.put(blob_bytes(rng, 150_000))  # within burst
+        with pytest.raises(Exception, match="bandwidth limit"):
+            c.access.put(blob_bytes(rng, 150_000))  # bucket dry
+    finally:
+        c.close()
