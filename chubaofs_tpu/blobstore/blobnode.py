@@ -81,19 +81,80 @@ class ShardMeta:
 
 
 class Chunk:
-    """One append-only chunk datafile + its shard index."""
+    """One append-only chunk datafile + its shard index.
+
+    Compaction is generational (core/storage compaction analog): gen G lives
+    in `<chunk>.data` (G=0) or `<chunk>.g<G>.data`; a compaction writes gen
+    G+1 fully, then commits the gen bump AND every re-offset shard meta in ONE
+    atomic metadb batch. A crash before the batch leaves gen G valid (the
+    orphan G+1 file is swept on open); after it, gen G+1 is valid and stale
+    files are swept on open.
+    """
 
     def __init__(self, path: str, chunk_id: str, max_size: int, metadb):
         self.chunk_id = chunk_id
         self.max_size = max_size
-        self._data_path = path + ".data"
+        self._base_path = path
         self._idx_path = path + ".idx"  # legacy json-line WAL (migrated)
         self._db = metadb
         self._lock = threading.Lock()
         self.shards: dict[int, ShardMeta] = {}
+        self.gen = int(self._db.get(self._gen_key()) or 0)
+        self._data_path = self._gen_path(self.gen)
+        self.tombstones: set[int] = set()  # deleted bids (metadb tombstones)
+        self._check_committed_gen()
+        self._sweep_stale_gens()
         self._load()
         self._f = open(self._data_path, "r+b")
         self._size = os.path.getsize(self._data_path)
+        # garbage metric survives restarts: everything in the file that is not
+        # a live record is punched/superseded space (compaction trigger)
+        live = sum(HEADER_LEN + crc32block.encoded_len(m.size)
+                   for m in self.shards.values())
+        self.holes = max(0, self._size - live)
+
+    def _check_committed_gen(self):
+        """Never sweep while the committed generation's datafile is missing:
+        deleting the survivors would turn a recoverable inconsistency into
+        silent data loss. (compact() fsyncs the directory before the commit,
+        so this only fires on external damage — fail loudly.)"""
+        if os.path.exists(self._data_path):
+            return
+        d = os.path.dirname(self._base_path) or "."
+        stem = os.path.basename(self._base_path)
+        others = []
+        for f in os.listdir(d):
+            # same gen-suffix filter as _sweep_stale_gens: 'vuid-2560.data' is
+            # NOT a generation of chunk 'vuid-256'
+            if not f.startswith(stem) or not f.endswith(".data"):
+                continue
+            mid = f[len(stem):-len(".data")]
+            if (mid == "" or (mid.startswith(".g") and mid[2:].isdigit())) \
+                    and os.path.join(d, f) != self._data_path:
+                others.append(f)
+        if others:
+            raise BlobNodeError(
+                f"chunk {self.chunk_id}: committed gen {self.gen} datafile "
+                f"missing but {others} exist — refusing to sweep")
+
+    def _gen_key(self) -> bytes:
+        return f"g/{self.chunk_id}".encode()
+
+    def _gen_path(self, gen: int) -> str:
+        return self._base_path + (".data" if gen == 0 else f".g{gen}.data")
+
+    def _sweep_stale_gens(self):
+        """Drop datafiles of any generation other than the committed one."""
+        d = os.path.dirname(self._base_path) or "."
+        stem = os.path.basename(self._base_path)
+        for fname in os.listdir(d):
+            if not fname.startswith(stem) or not fname.endswith(".data"):
+                continue
+            full = os.path.join(d, fname)
+            if full != self._data_path:
+                mid = fname[len(stem):-len(".data")]
+                if mid == "" or (mid.startswith(".g") and mid[2:].isdigit()):
+                    os.unlink(full)
 
     def _key(self, bid: int) -> bytes:
         # fixed-width decimal keeps the metadb's byte order == bid order
@@ -107,22 +168,25 @@ class Chunk:
                 for line in f:
                     if not line.strip():
                         continue
+                    # DELETED entries become tombstones too: delete intent
+                    # must survive the migration or the inspector could
+                    # resurrect a partially-deleted blob
                     meta = ShardMeta(**json.loads(line))
-                    if meta.status == STATUS_DELETED:
-                        self._db.delete(self._key(meta.bid))
-                    else:
-                        self._db.put(self._key(meta.bid),
-                                     json.dumps(meta.__dict__).encode())
+                    self._db.put(self._key(meta.bid),
+                                 json.dumps(meta.__dict__).encode())
             os.replace(self._idx_path, self._idx_path + ".migrated")
         for _, v in self._db.scan(prefix=f"s/{self.chunk_id}/".encode()):
             meta = ShardMeta(**json.loads(v))
-            self.shards[meta.bid] = meta
+            if meta.status == STATUS_DELETED:
+                self.tombstones.add(meta.bid)  # deleted, not lost
+            else:
+                self.shards[meta.bid] = meta
 
     def _log_idx(self, meta: ShardMeta):
-        if meta.status == STATUS_DELETED:
-            self._db.delete(self._key(meta.bid))
-        else:
-            self._db.put(self._key(meta.bid), json.dumps(meta.__dict__).encode())
+        # STATUS_DELETED stays in the metadb as a TOMBSTONE: the volume
+        # inspector must be able to tell "deleted here" from "lost here", or a
+        # partially-applied blob delete would be resurrected as a repair
+        self._db.put(self._key(meta.bid), json.dumps(meta.__dict__).encode())
 
     @property
     def used(self) -> int:
@@ -142,12 +206,13 @@ class Chunk:
             self._size = offset + HEADER_LEN + len(framed)
             meta = ShardMeta(bid=bid, vuid=vuid, offset=offset, size=len(payload))
             self.shards[bid] = meta
+            self.tombstones.discard(bid)  # re-put over a tombstone revives it
             self._log_idx(meta)
             if old is not None:
                 # re-put (e.g. repeated repair): release the superseded record
-                _punch_hole(
-                    self._f.fileno(), old.offset, HEADER_LEN + crc32block.encoded_len(old.size)
-                )
+                length = HEADER_LEN + crc32block.encoded_len(old.size)
+                _punch_hole(self._f.fileno(), old.offset, length)
+                self.holes += length
             return meta
 
     def get(self, bid: int, offset: int = 0, size: int | None = None) -> bytes:
@@ -183,9 +248,72 @@ class Chunk:
                 raise NoSuchShard(f"chunk {self.chunk_id} bid {bid}")
             length = HEADER_LEN + crc32block.encoded_len(meta.size)
             _punch_hole(self._f.fileno(), meta.offset, length)
+            self.holes += length
             meta.status = STATUS_DELETED
             self._log_idx(meta)
+            self.tombstones.add(meta.bid)
             del self.shards[meta.bid]
+
+    def compact(self) -> int:
+        """Rewrite the datafile keeping only live records; returns bytes
+        reclaimed. Crash-safe via the generational commit described on the
+        class docstring."""
+        with self._lock:
+            new_gen = self.gen + 1
+            new_path = self._gen_path(new_gen)
+            new_metas: list[ShardMeta] = []
+            with open(new_path, "wb") as out:
+                for bid, meta in sorted(self.shards.items(),
+                                        key=lambda kv: kv[1].offset):
+                    length = HEADER_LEN + crc32block.encoded_len(meta.size)
+                    self._f.seek(meta.offset)
+                    record = self._f.read(length)
+                    new_metas.append(ShardMeta(bid=bid, vuid=meta.vuid,
+                                               offset=out.tell(),
+                                               size=meta.size,
+                                               status=meta.status))
+                    out.write(record)
+                out.flush()
+                os.fsync(out.fileno())
+            # the new file's DIRECTORY ENTRY must be durable before the gen
+            # bump commits, or a crash could leave a committed gen with no file
+            dfd = os.open(os.path.dirname(new_path) or ".", os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+            # commit point: gen bump + every re-offset meta, atomically.
+            # Tombstones are RETAINED: they are cluster-level delete intent
+            # ("deleted here, not lost"), not file-local garbage — purging them
+            # would let the inspector resurrect a partially-deleted blob
+            puts = [(self._gen_key(), str(new_gen).encode())]
+            puts += [(self._key(m.bid), json.dumps(m.__dict__).encode())
+                     for m in new_metas]
+            self._db.write_batch(puts=puts)
+            old_path, old_size = self._data_path, self._size
+            self._f.close()
+            self.gen = new_gen
+            self._data_path = new_path
+            self._f = open(new_path, "r+b")
+            self._size = os.path.getsize(new_path)
+            self.shards = {m.bid: m for m in new_metas}
+            self.holes = 0
+            if old_path != new_path:
+                os.unlink(old_path)
+            return old_size - self._size
+
+    def lose(self, bid: int):
+        """Drop a record WITHOUT a tombstone — models media loss (a lost
+        sector/file), as opposed to delete(), which records intent. The
+        inspector repairs lost shards but finishes deleted ones."""
+        with self._lock:
+            meta = self.shards.pop(bid, None)
+            if meta is None:
+                raise NoSuchShard(f"chunk {self.chunk_id} bid {bid}")
+            length = HEADER_LEN + crc32block.encoded_len(meta.size)
+            _punch_hole(self._f.fileno(), meta.offset, length)
+            self.holes += length
+            self._db.delete(self._key(bid))
 
     def list_shards(self) -> list[ShardMeta]:
         with self._lock:
@@ -321,11 +449,53 @@ class BlobNode:
     def list_shards(self, vuid: int) -> list[ShardMeta]:
         return self._chunk(vuid).list_shards()
 
+    def lose_shard(self, vuid: int, bid: int) -> None:
+        """Simulate media loss of one shard (no delete tombstone)."""
+        self._chunk(vuid).lose(bid)
+
+    def has_tombstone(self, vuid: int, bid: int) -> bool:
+        """True when this bid was DELETED here (vs never written / lost)."""
+        try:
+            return bid in self._chunk(vuid).tombstones
+        except NoSuchShard:
+            return False
+
     def stats(self) -> dict:
         return {
             "node_id": self.node_id,
             "disks": [d.stats() for d in self.disks.values()],
         }
+
+    # -- background hygiene (core compaction + datainspect.go analogs) -------
+
+    def compact_once(self, min_hole_ratio: float = 0.25,
+                     min_holes: int = 1 << 20) -> int:
+        """Compact every chunk whose punched-hole share crosses the threshold;
+        returns total bytes reclaimed."""
+        reclaimed = 0
+        for disk in self.disks.values():
+            for chunk in list(disk.chunks.values()):
+                if chunk.used and chunk.holes >= min_holes and \
+                        chunk.holes / chunk.used >= min_hole_ratio:
+                    reclaimed += chunk.compact()
+        return reclaimed
+
+    def inspect_once(self) -> list[tuple[int, int]]:
+        """CRC scrub (blobnode/datainspect.go): re-read every live shard
+        through the crc32block framing; returns [(vuid, bid)] that fail."""
+        bad: list[tuple[int, int]] = []
+        for vuid, (disk_id, cid) in list(self._chunk_of_vuid.items()):
+            chunk = self.disks[disk_id].chunks.get(cid)
+            if chunk is None:
+                continue
+            for meta in chunk.list_shards():
+                if meta.status != STATUS_NORMAL:
+                    continue
+                try:
+                    chunk.get(meta.bid)
+                except Exception:
+                    bad.append((vuid, meta.bid))
+        return bad
 
     def close(self):
         for d in self.disks.values():

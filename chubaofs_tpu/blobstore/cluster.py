@@ -46,17 +46,21 @@ class MiniCluster:
 
     def run_background_once(self) -> dict:
         """One tick of every background loop (the 16-ticker scheduleTask analog)."""
+        inspected = self.scheduler.inspect_volumes()
         polled = self.scheduler.poll_repair_topic()
         disk_tasks = self.scheduler.check_disks()
         ran = 0
         while self.worker.run_once():
             ran += 1
         deleted = self.scheduler.run_deleter()
+        compacted = sum(n.compact_once() for n in self.nodes.values())
         return {
+            "inspect_msgs": inspected,
             "repair_msgs": polled,
             "disk_tasks": len(disk_tasks),
             "tasks_ran": ran,
             "deletes": deleted,
+            "compacted_bytes": compacted,
         }
 
     def close(self):

@@ -87,6 +87,7 @@ class Scheduler:
         self._lock = threading.Lock()
         self._tasks: dict[str, Task] = {}
         self._seq = 0
+        self._inspect_cursor = 0  # round-robin position over volume ids
 
     # -- task table ----------------------------------------------------------
 
@@ -158,6 +159,79 @@ class Scheduler:
                 continue
             out.append(self._new_task(kind=KIND_DISK_REPAIR, disk_id=disk.disk_id))
         return out
+
+    def inspect_volumes(self, max_volumes: int = 4) -> int:
+        """Proactive integrity sweep (scheduler/volume_inspector.go): walk a
+        cursor-bounded batch of volumes, verify every stripe position of every
+        bid is present AND passes its crc32block framing, and feed anything
+        broken to the repair topic — discovery without waiting for a client GET.
+        Gated by SWITCH_VOL_INSPECT. Returns repair messages produced."""
+        from chubaofs_tpu.blobstore.blobnode import STATUS_MARK_DELETE
+        from chubaofs_tpu.blobstore.taskswitch import SWITCH_VOL_INSPECT
+
+        if not self.switches.enabled(SWITCH_VOL_INSPECT):
+            return 0
+        with self._lock:
+            vids = sorted(self.cm.volumes)
+            if not vids:
+                return 0
+            start = self._inspect_cursor % len(vids)
+            batch = (vids[start:] + vids[:start])[:max_volumes]
+            self._inspect_cursor = (start + len(batch)) % len(vids)
+        produced = 0
+        for vid in batch:
+            vol = self.cm.get_volume(vid)
+            t = vol.tactic()
+            # bid -> stripe positions holding it, with index status
+            seen: dict[int, dict[int, int]] = {}
+            for u in vol.units:
+                node = self.nodes.get(u.node_id)
+                if node is None:
+                    continue
+                try:
+                    metas = node.list_shards(u.vuid)
+                except Exception:
+                    continue
+                for m in metas:
+                    seen.setdefault(m.bid, {})[u.index] = m.status
+            for bid, have in sorted(seen.items()):
+                # a tombstone ANYWHERE means this bid was deleted: finish the
+                # partial delete (idempotent, retried every sweep) instead of
+                # resurrecting it — checked BEFORE the mark-delete skip so a
+                # half-marked straggler can't wedge forever
+                tombstoned = any(
+                    self.nodes.get(u.node_id) is not None
+                    and self.nodes[u.node_id].has_tombstone(u.vuid, bid)
+                    for u in vol.units
+                )
+                if tombstoned:
+                    for idx in have:
+                        unit = vol.units[idx]
+                        node = self.nodes.get(unit.node_id)
+                        if node is None:
+                            continue
+                        try:
+                            node.delete_shard(unit.vuid, bid)
+                        except Exception:
+                            pass  # node down: retried on the next sweep
+                    continue
+                if any(st == STATUS_MARK_DELETE for st in have.values()):
+                    continue  # delete in flight; the deleter owns this bid
+                bad = []
+                for idx in range(t.total):
+                    unit = vol.units[idx]
+                    node = self.nodes.get(unit.node_id)
+                    if node is None or idx not in have:
+                        bad.append(idx)
+                        continue
+                    try:
+                        node.get_shard(unit.vuid, bid)  # full CRC-framed read
+                    except Exception:
+                        bad.append(idx)
+                if bad:
+                    self.proxy.send_shard_repair(vid, bid, bad, "inspect")
+                    produced += 1
+        return produced
 
     def drop_disk(self, disk_id: int) -> Task:
         """Manual decommission -> migrate everything off (disk_drop analog)."""

@@ -291,6 +291,13 @@ class MasterClient:
 
                 time.sleep(0.1)
                 continue
+            if code == CODE_BUSY:
+                # QoS throttle, not a hard failure: back off and retry
+                import time
+
+                last_msg = out.get("msg", "rate limited")
+                time.sleep(0.2)
+                continue
             last_msg = out.get("msg", "error")
             raise MasterError(last_msg)
         raise MasterError(f"master unavailable: {last_msg}")

@@ -73,7 +73,7 @@ def test_get_with_lost_shards_reconstructs(cluster, rng):
     vol = cluster.cm.get_volume(blob.vid)
     for idx in (0, 5, 13, 15):  # 2 data + 2 parity... idx 13,15 parity; 0,5 data
         unit = vol.units[idx]
-        cluster.nodes[unit.node_id].delete_shard(unit.vuid, blob.bid)
+        cluster.nodes[unit.node_id].lose_shard(unit.vuid, blob.bid)
     assert cluster.access.get(loc) == data
     assert cluster.proxy.topics["shard_repair"].lag("scheduler") > 0
 
@@ -85,7 +85,7 @@ def test_get_beyond_parity_budget_fails(cluster, rng):
     vol = cluster.cm.get_volume(blob.vid)
     for idx in (0, 1, 3, 4):  # 4 missing > M=3
         unit = vol.units[idx]
-        cluster.nodes[unit.node_id].delete_shard(unit.vuid, blob.bid)
+        cluster.nodes[unit.node_id].lose_shard(unit.vuid, blob.bid)
     with pytest.raises(Exception):
         cluster.access.get(loc)
 
@@ -99,7 +99,7 @@ def test_background_shard_repair(cluster, rng):
     killed = [2, 7]
     for idx in killed:
         unit = vol.units[idx]
-        cluster.nodes[unit.node_id].delete_shard(unit.vuid, blob.bid)
+        cluster.nodes[unit.node_id].lose_shard(unit.vuid, blob.bid)
     # reading triggers reconstruction + repair message
     assert cluster.access.get(loc) == data
     stats = cluster.run_background_once()
@@ -235,7 +235,7 @@ def test_repair_task_dedup(cluster, rng):
     blob = loc.blobs[0]
     vol = cluster.cm.get_volume(blob.vid)
     unit = vol.units[2]
-    cluster.nodes[unit.node_id].delete_shard(unit.vuid, blob.bid)
+    cluster.nodes[unit.node_id].lose_shard(unit.vuid, blob.bid)
     for _ in range(4):
         assert cluster.access.get(loc) == data  # each emits a repair message
     cluster.scheduler.poll_repair_topic()

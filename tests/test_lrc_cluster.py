@@ -178,9 +178,14 @@ def test_local_stripe_repair_reads_same_az_only(cluster3az, rng):
 
     lost_idx = t.shards_in_az(0)[0]  # a data shard in AZ 0
     unit = vol.units[lost_idx]
-    c.nodes[unit.node_id].delete_shard(unit.vuid, bid)
+    c.nodes[unit.node_id].lose_shard(unit.vuid, bid)
     c.proxy.send_shard_repair(vol.vid, bid, [lost_idx], "test")
 
+    # gate off the volume inspector: it legitimately sweeps every AZ, and this
+    # test asserts only on the REPAIR's read set
+    from chubaofs_tpu.blobstore.taskswitch import SWITCH_VOL_INSPECT
+
+    c.scheduler.switches.set(SWITCH_VOL_INSPECT, False)
     recorders = {n: RecordingNode(node) for n, node in c.nodes.items()}
     c.nodes.clear()
     c.nodes.update(recorders)
@@ -209,9 +214,12 @@ def test_lost_local_parity_recomputed_in_az(cluster3az, rng):
     assert local_idx >= t.global_count
     unit = vol.units[local_idx]
     before = c.nodes[unit.node_id].get_shard(unit.vuid, bid)
-    c.nodes[unit.node_id].delete_shard(unit.vuid, bid)
+    c.nodes[unit.node_id].lose_shard(unit.vuid, bid)
     c.proxy.send_shard_repair(vol.vid, bid, [local_idx], "test")
 
+    from chubaofs_tpu.blobstore.taskswitch import SWITCH_VOL_INSPECT
+
+    c.scheduler.switches.set(SWITCH_VOL_INSPECT, False)  # see test above
     recorders = {n: RecordingNode(node) for n, node in c.nodes.items()}
     c.nodes.clear()
     c.nodes.update(recorders)
@@ -236,7 +244,7 @@ def test_two_az_lrc_roundtrip(tmp_path, rng):
         vol = c.cm.get_volume(loc.blobs[0].vid)
         for idx in (0, 1):
             u = vol.units[idx]
-            c.nodes[u.node_id].delete_shard(u.vuid, loc.blobs[0].bid)
+            c.nodes[u.node_id].lose_shard(u.vuid, loc.blobs[0].bid)
         assert c.access.get(loc) == data
     finally:
         c.close()
