@@ -19,6 +19,7 @@ master/cluster.go:329-3587) — so node restarts and missed hooks converge.
 from __future__ import annotations
 
 import json
+import os
 import sys
 import threading
 import time
@@ -691,6 +692,14 @@ def main(argv: list[str] | None = None) -> int:
     args = p.parse_args(argv)
     with open(args.config) as f:
         cfg = json.load(f)
+    # honor an explicit JAX_PLATFORMS request even when a sitecustomize-
+    # registered accelerator plugin overrides the env var: a daemon told to
+    # run on CPU must never silently depend on a proxied TPU's health
+    plat = cfg.get("jaxPlatform") or os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+
+        jax.config.update("jax_platforms", plat)
     daemon = start_role(cfg)
     addr = getattr(daemon, "addr", "")
     print(json.dumps({"role": cfg["role"], "addr": addr}), flush=True)

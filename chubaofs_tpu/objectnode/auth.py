@@ -138,10 +138,69 @@ def verify_v4(req, secret_key: str) -> bool:
     return hmac.compare_digest(want, info["signature"])
 
 
+# -- SigV4 presigned URLs (query auth) -----------------------------------------
+
+def presign_v4(method: str, path: str, host: str, access_key: str,
+               secret_key: str, region: str = "cfs", expires: int = 900,
+               extra_query: str = "", amz_date: str | None = None) -> str:
+    """Return the full query string of a presigned-V4 URL for `path`.
+
+    Only `host` is signed (the aws-cli default); the payload is UNSIGNED."""
+    import time
+
+    path = urllib.parse.unquote(path)
+    amz_date = amz_date or time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    date = amz_date[:8]
+    scope = f"{date}/{region}/s3/aws4_request"
+    q = {
+        "X-Amz-Algorithm": V4_ALGO,
+        "X-Amz-Credential": f"{access_key}/{scope}",
+        "X-Amz-Date": amz_date,
+        "X-Amz-Expires": str(expires),
+        "X-Amz-SignedHeaders": "host",
+    }
+    raw = extra_query + ("&" if extra_query else "") + urllib.parse.urlencode(q)
+    creq = canonical_request_v4(method, path, raw, {"host": host}, ["host"],
+                                UNSIGNED_PAYLOAD)
+    sts = string_to_sign_v4(amz_date, scope, creq)
+    sig = hmac.new(signing_key(secret_key, date, region), sts.encode(),
+                   hashlib.sha256).hexdigest()
+    return raw + "&X-Amz-Signature=" + sig
+
+
+def verify_presigned_v4(req, secret_key: str) -> bool:
+    """Verify a query-auth (presigned) V4 request, including expiry."""
+    import time
+
+    q = {k: v[0] for k, v in req.query.items() if v}
+    try:
+        cred = q["X-Amz-Credential"].split("/")
+        amz_date, expires = q["X-Amz-Date"], int(q["X-Amz-Expires"])
+        signed_headers = q["X-Amz-SignedHeaders"].split(";")
+        sig = q["X-Amz-Signature"]
+    except (KeyError, IndexError, ValueError):
+        return False
+    import calendar
+
+    t0 = calendar.timegm(time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
+    if time.time() > t0 + expires:
+        return False
+    date, region, service = cred[1], cred[2], cred[3]
+    raw = _canonical_query(req.raw_query, drop=frozenset(("X-Amz-Signature",)))
+    creq = canonical_request_v4(req.method, req.path, raw, req.headers,
+                                signed_headers, UNSIGNED_PAYLOAD)
+    scope = f"{date}/{region}/{service}/aws4_request"
+    sts = string_to_sign_v4(amz_date, scope, creq)
+    key = signing_key(secret_key, date, region, service)
+    want = hmac.new(key, sts.encode(), hashlib.sha256).hexdigest()
+    return hmac.compare_digest(want, sig)
+
+
 # -- SigV2 ---------------------------------------------------------------------
 
-_V2_SUBRESOURCES = ("acl", "cors", "delete", "location", "policy", "tagging",
-                    "uploads", "uploadId", "partNumber", "versioning")
+_V2_SUBRESOURCES = ("acl", "cors", "delete", "lifecycle", "location", "policy",
+                    "tagging", "uploads", "uploadId", "partNumber",
+                    "versionId", "versioning", "versions")
 
 
 def _canonical_resource_v2(path: str, raw_query: str) -> str:
@@ -183,8 +242,46 @@ def verify_v2(req, secret_key: str) -> bool:
     return hmac.compare_digest(want, sig)
 
 
+def presign_v2(method: str, path: str, access_key: str, secret_key: str,
+               expires_at: int) -> str:
+    """Query string of a V2 presigned URL (AWSAccessKeyId/Expires/Signature)."""
+    path = urllib.parse.unquote(path)
+    sts = f"{method.upper()}\n\n\n{expires_at}\n{_canonical_resource_v2(path, '')}"
+    sig = b64encode(hmac.new(secret_key.encode(), sts.encode(),
+                             hashlib.sha1).digest()).decode()
+    return urllib.parse.urlencode(
+        {"AWSAccessKeyId": access_key, "Expires": expires_at, "Signature": sig})
+
+
+def verify_presigned_v2(req, secret_key: str) -> bool:
+    import time
+
+    try:
+        expires_at = int(req.query["Expires"][0])
+        sig = req.query["Signature"][0]
+    except (KeyError, IndexError, ValueError):
+        return False
+    if time.time() > expires_at:
+        return False
+    sts = (f"{req.method.upper()}\n\n\n{expires_at}\n"
+           f"{_canonical_resource_v2(req.path, '')}")
+    want = b64encode(hmac.new(secret_key.encode(), sts.encode(),
+                              hashlib.sha1).digest()).decode()
+    return hmac.compare_digest(want, sig)
+
+
+def is_presigned(req) -> bool:
+    return "X-Amz-Signature" in req.query or "Signature" in req.query
+
+
+def verify_presigned(req, secret_key: str) -> bool:
+    if "X-Amz-Signature" in req.query:
+        return verify_presigned_v4(req, secret_key)
+    return verify_presigned_v2(req, secret_key)
+
+
 def access_key_of(req) -> str | None:
-    """Pull the access key out of either auth flavor (router pre-step)."""
+    """Pull the access key out of any auth flavor (router pre-step)."""
     auth = req.header("authorization")
     if auth.startswith(V4_ALGO):
         try:
@@ -193,4 +290,11 @@ def access_key_of(req) -> str | None:
             return None
     if auth.startswith("AWS ") and ":" in auth:
         return auth[4:].rsplit(":", 1)[0]
+    # presigned flavors carry the key in the query
+    cred = req.query.get("X-Amz-Credential")
+    if cred:
+        return cred[0].split("/")[0]
+    ak = req.query.get("AWSAccessKeyId")
+    if ak:
+        return ak[0]
     return None

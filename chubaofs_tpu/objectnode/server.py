@@ -12,7 +12,10 @@ Supported S3 actions: ListBuckets, Create/Delete/Head Bucket,
 GetBucketLocation, ListObjects V1/V2, Put/Get/Head/Delete/Copy Object,
 DeleteObjects (batch), Range GET, Bucket+Object ACL, Bucket Policy,
 Bucket CORS (+ preflight), Bucket+Object Tagging, full multipart
-(Initiate/UploadPart/List/Complete/Abort/ListUploads).
+(Initiate/UploadPart/UploadPartCopy/List/Complete/Abort/ListUploads),
+Bucket Versioning (Put/Get, ListObjectVersions, versionId GET/DELETE,
+delete markers), Bucket Lifecycle (Put/Get/Delete + expiry sweeper),
+presigned URLs (SigV4 query auth and SigV2 Expires/Signature).
 """
 
 from __future__ import annotations
@@ -32,10 +35,13 @@ from chubaofs_tpu.objectnode.policy import (
     ACTION_DELETE, ACTION_GET, ACTION_LIST, ACTION_PUT, ALLOW, DENY, Policy,
     PolicyError, XATTR_POLICY,
 )
-from chubaofs_tpu.objectnode.volume import NoSuchKey, OSSVolume
+from chubaofs_tpu.objectnode.volume import NoSuchKey, OSSVolume, ReservedKey
 from chubaofs_tpu.rpc import Response, Router
 from chubaofs_tpu.rpc.router import Request
 from chubaofs_tpu.sdk.fs import FsError
+
+
+XATTR_LIFECYCLE = "oss:lifecycle"
 
 
 class S3Error(Exception):
@@ -55,8 +61,12 @@ def _xml_error(e: S3Error, resource: str = "") -> Response:
 def _parse_xml(body: bytes) -> ET.Element:
     """Parse an S3 request body, stripping the S3 namespace: boto/aws-cli send
     xmlns=http://s3.amazonaws.com/doc/2006-03-01/ and ElementTree would
-    otherwise tag every element as {ns}Name."""
-    root = ET.fromstring(body.decode())
+    otherwise tag every element as {ns}Name. Malformed input is the client's
+    fault — 400 MalformedXML, never a 500."""
+    try:
+        root = ET.fromstring(body.decode())
+    except (ET.ParseError, UnicodeDecodeError) as e:
+        raise S3Error(400, "MalformedXML", str(e)) from None
     for el in root.iter():
         el.tag = re.sub(r"^\{.*\}", "", el.tag)
     return root
@@ -109,6 +119,12 @@ class ObjectNode:
         if user is None:
             raise S3Error(403, "InvalidAccessKeyId", ak)
         sk = user["secret_key"]
+        if s3auth.is_presigned(req):
+            # query-string auth (presigned URLs), expiry enforced
+            if not s3auth.verify_presigned(req, sk):
+                raise S3Error(403, "SignatureDoesNotMatch",
+                              "presigned signature invalid or expired")
+            return user.get("uid", ak)
         authz = req.header("authorization")
         ok = (s3auth.verify_v4(req, sk) if authz.startswith(s3auth.V4_ALGO)
               else s3auth.verify_v2(req, sk))
@@ -179,6 +195,13 @@ class ObjectNode:
         r.put("/:bucket", w(self.put_bucket_tagging), queries={"tagging": None})
         r.delete("/:bucket", w(self.delete_bucket_tagging), queries={"tagging": None})
         r.get("/:bucket", w(self.list_uploads), queries={"uploads": None})
+        r.get("/:bucket", w(self.get_bucket_versioning), queries={"versioning": None})
+        r.put("/:bucket", w(self.put_bucket_versioning), queries={"versioning": None})
+        r.get("/:bucket", w(self.list_object_versions), queries={"versions": None})
+        r.get("/:bucket", w(self.get_bucket_lifecycle), queries={"lifecycle": None})
+        r.put("/:bucket", w(self.put_bucket_lifecycle), queries={"lifecycle": None})
+        r.delete("/:bucket", w(self.delete_bucket_lifecycle),
+                 queries={"lifecycle": None})
         r.get("/:bucket", w(self.list_objects_v2), queries={"list-type": "2"})
         r.post("/:bucket", w(self.delete_objects), queries={"delete": None})
         # bucket core
@@ -217,6 +240,11 @@ class ObjectNode:
                 return _xml_error(e, req.path)
             except NoSuchKey as e:
                 return _xml_error(S3Error(404, "NoSuchKey", str(e)), req.path)
+            except ReservedKey as e:
+                return _xml_error(
+                    S3Error(400, "InvalidArgument",
+                            f"key {e} addresses the reserved version store"),
+                    req.path)
             except NoSuchUpload as e:
                 return _xml_error(S3Error(404, "NoSuchUpload", str(e)), req.path)
             except InvalidPart as e:
@@ -320,6 +348,31 @@ class ObjectNode:
 
     # -- object core -------------------------------------------------------------
 
+    @staticmethod
+    def _version_prologue(vol: OSSVolume, key: str) -> str | None:
+        """Before overwriting `key`: retain the prior version per the bucket's
+        versioning state. Enabled — archive whatever is current and mint a new
+        version id for the incoming write. Suspended — archive only a current
+        that carries a REAL version id (the 'null' version is overwritten, the
+        versioned history is retained; S3 Suspended semantics); the incoming
+        write stays the null version. Returns the new version id or None."""
+        status = vol.versioning_status()
+        if not status or key.endswith("/"):
+            return None
+        if status == "Enabled":
+            vol.archive_current(key)
+            return vol.new_version_id()
+        if vol._current_vid(key) is not None:  # Suspended, real current
+            vol.archive_current(key)
+        return None
+
+    @staticmethod
+    def _version_epilogue(vol: OSSVolume, key: str, vid: str | None):
+        if vid is not None:
+            from chubaofs_tpu.objectnode.volume import XATTR_VERSION_ID
+
+            vol.fs.setxattr("/" + key, XATTR_VERSION_ID, vid.encode())
+
     def put_object(self, req: Request):
         bucket, key = req.params["bucket"], req.params["key"]
         self._check(req, bucket, ACTION_PUT, key)
@@ -327,11 +380,16 @@ class ObjectNode:
         src = req.header("x-amz-copy-source")
         if src:
             return self._copy_object(req, vol, key, src)
+        vid = self._version_prologue(vol, key)
         user_meta = {k[len("x-amz-meta-"):]: v for k, v in req.headers.items()
                      if k.startswith("x-amz-meta-")}
         etag = vol.put_object(key, req.body, req.header("content-type"),
                               user_meta or None)
-        return Response(200, {"ETag": f'"{etag}"'})
+        self._version_epilogue(vol, key, vid)
+        headers = {"ETag": f'"{etag}"'}
+        if vid is not None:
+            headers["x-amz-version-id"] = vid
+        return Response(200, headers)
 
     def _copy_object(self, req: Request, vol: OSSVolume, key: str, src: str):
         src = urllib.parse.unquote(src).lstrip("/")
@@ -340,8 +398,10 @@ class ObjectNode:
         src_vol = self._vol(src_bucket)
         info = src_vol.info(src_key)
         data = src_vol.get_object(src_key)
+        vid = self._version_prologue(vol, key)
         etag = vol.put_object(key, data, info["content_type"],
                               info["meta"] or None)
+        self._version_epilogue(vol, key, vid)
         return Response.xml(
             f"<CopyObjectResult><ETag>&quot;{etag}&quot;</ETag>"
             f"<LastModified>{OSSVolume.http_time(info['mtime'])}</LastModified>"
@@ -360,8 +420,20 @@ class ObjectNode:
         bucket, key = req.params["bucket"], req.params["key"]
         self._check(req, bucket, ACTION_GET, key)
         vol = self._vol(bucket)
-        info = vol.info(key)
+        vid = req.q("versionId")
+        if vid:
+            info = vol.stat_version(key, vid)
+
+            def read(off, sz):
+                return vol.read_version(key, vid, off, sz)
+        else:
+            info = vol.info(key)
+
+            def read(off, sz):
+                return vol.get_object(key, off, sz)
         headers = self._object_headers(info)
+        if vid:
+            headers["x-amz-version-id"] = vid
         rng = req.header("range")
         if rng and rng.startswith("bytes="):
             try:
@@ -378,15 +450,19 @@ class ObjectNode:
             if lo >= info["size"] or lo > hi:
                 raise S3Error(416, "InvalidRange", rng)
             hi = min(hi, info["size"] - 1)
-            data = vol.get_object(key, lo, hi - lo + 1)
             headers["Content-Range"] = f"bytes {lo}-{hi}/{info['size']}"
-            return Response(206, headers, data)
-        return Response(200, headers, vol.get_object(key))
+            return Response(206, headers, read(lo, hi - lo + 1))
+        return Response(200, headers, read(0, None))
 
     def head_object(self, req: Request):
         bucket, key = req.params["bucket"], req.params["key"]
         self._check(req, bucket, ACTION_GET, key)
-        info = self._vol(bucket).info(key)
+        vol = self._vol(bucket)
+        vid = req.q("versionId")
+        if vid:
+            _, info = vol.get_version(key, vid)
+        else:
+            info = vol.info(key)
         headers = self._object_headers(info)
         headers["Content-Length"] = str(info["size"])
         return Response(200, headers)
@@ -394,7 +470,23 @@ class ObjectNode:
     def delete_object(self, req: Request):
         bucket, key = req.params["bucket"], req.params["key"]
         self._check(req, bucket, ACTION_DELETE, key)
-        self._vol(bucket).delete_object(key)
+        vol = self._vol(bucket)
+        vid = req.q("versionId")
+        if vid:
+            vol.delete_version(key, vid)
+            return Response(204, {"x-amz-version-id": vid})
+        status = vol.versioning_status()
+        if status:
+            # versioned delete: retain history, record a marker. Suspended
+            # removes the null current outright but still keeps real versions.
+            if status == "Enabled" or vol._current_vid(key) is not None:
+                vol.archive_current(key)
+            else:
+                vol.delete_object(key)
+            marker_vid = vol.put_delete_marker(key)
+            return Response(204, {"x-amz-delete-marker": "true",
+                                  "x-amz-version-id": marker_vid})
+        vol.delete_object(key)
         return Response(204)
 
     def delete_objects(self, req: Request):
@@ -402,11 +494,16 @@ class ObjectNode:
         self._check(req, bucket, ACTION_DELETE)
         vol = self._vol(bucket)
         root = _parse_xml(req.body)
+        versioned = vol.versioning_status() == "Enabled"
         deleted = []
         for obj in root.iter("Object"):
             key = _text(obj, "Key")
             if key:
-                vol.delete_object(key)
+                if versioned:
+                    vol.archive_current(key)
+                    vol.put_delete_marker(key)
+                else:
+                    vol.delete_object(key)
                 deleted.append(key)
         body = "".join(f"<Deleted><Key>{esc(k)}</Key></Deleted>" for k in deleted)
         return Response.xml(f"<DeleteResult>{body}</DeleteResult>")
@@ -602,8 +699,37 @@ class ObjectNode:
             part_num = int(req.q("partNumber"))
         except ValueError:
             raise S3Error(400, "InvalidArgument", "partNumber") from None
+        src = req.header("x-amz-copy-source")
+        if src:
+            return self._upload_part_copy(req, bucket, part_num, src)
         etag = self._mpu(bucket).put_part(req.q("uploadId"), part_num, req.body)
         return Response(200, {"ETag": f'"{etag}"'})
+
+    def _upload_part_copy(self, req: Request, bucket: str, part_num: int,
+                          src: str):
+        """UploadPartCopy: the part's bytes come from an existing object
+        (optionally a byte range), not the request body."""
+        src = urllib.parse.unquote(src).lstrip("/")
+        src_bucket, _, src_key = src.partition("/")
+        self._check(req, src_bucket, ACTION_GET, src_key)
+        src_vol = self._vol(src_bucket)
+        info = src_vol.info(src_key)
+        rng = req.header("x-amz-copy-source-range")
+        if rng:
+            m = re.fullmatch(r"bytes=(\d+)-(\d+)", rng.strip())
+            if not m:
+                raise S3Error(400, "InvalidArgument", rng)
+            lo, hi = int(m.group(1)), int(m.group(2))
+            if lo > hi or hi >= info["size"]:
+                raise S3Error(416, "InvalidRange", rng)
+            data = src_vol.get_object(src_key, lo, hi - lo + 1)
+        else:
+            data = src_vol.get_object(src_key)
+        etag = self._mpu(bucket).put_part(req.q("uploadId"), part_num, data)
+        return Response.xml(
+            f"<CopyPartResult><ETag>&quot;{etag}&quot;</ETag>"
+            f"<LastModified>{OSSVolume.http_time(info['mtime'])}</LastModified>"
+            f"</CopyPartResult>")
 
     def list_parts(self, req: Request):
         bucket = req.params["bucket"]
@@ -637,7 +763,13 @@ class ObjectNode:
                     for p in root.iter("Part")]
         except ValueError:
             raise S3Error(400, "MalformedXML", "PartNumber") from None
-        final_key, etag = self._mpu(bucket).complete(req.q("uploadId"), spec)
+        vol = self._vol(bucket)
+        mpu = self._mpu(bucket)
+        # archive against the SESSION's key (the one complete() overwrites)
+        session_key, _ = mpu.list_parts(req.q("uploadId"))
+        vid = self._version_prologue(vol, session_key)
+        final_key, etag = mpu.complete(req.q("uploadId"), spec)
+        self._version_epilogue(vol, final_key, vid)
         return Response.xml(
             f"<CompleteMultipartUploadResult><Bucket>{esc(bucket)}</Bucket>"
             f"<Key>{esc(final_key)}</Key><ETag>&quot;{etag}&quot;</ETag>"
@@ -648,3 +780,136 @@ class ObjectNode:
         self._check(req, bucket, ACTION_DELETE, key)
         self._mpu(bucket).abort(req.q("uploadId"))
         return Response(204)
+
+    # -- versioning ----------------------------------------------------------------
+
+    def get_bucket_versioning(self, req: Request):
+        bucket = req.params["bucket"]
+        self._check(req, bucket, ACTION_GET)
+        status = self._vol(bucket).versioning_status()
+        inner = f"<Status>{status}</Status>" if status else ""
+        return Response.xml(f"<VersioningConfiguration>{inner}"
+                            f"</VersioningConfiguration>")
+
+    def put_bucket_versioning(self, req: Request):
+        bucket = req.params["bucket"]
+        self._check(req, bucket, ACTION_PUT)
+        status = _text(_parse_xml(req.body), "Status")
+        try:
+            self._vol(bucket).set_versioning(status)
+        except ValueError:
+            raise S3Error(400, "MalformedXML", f"Status {status!r}") from None
+        return Response(200)
+
+    def list_object_versions(self, req: Request):
+        bucket = req.params["bucket"]
+        self._check(req, bucket, ACTION_LIST)
+        entries = self._vol(bucket).list_versions(prefix=req.q("prefix"))
+        parts = []
+        for e in entries:
+            tag = "DeleteMarker" if e["delete_marker"] else "Version"
+            body = (f"<Key>{esc(e['key'])}</Key>"
+                    f"<VersionId>{e['version_id']}</VersionId>"
+                    f"<IsLatest>{'true' if e['is_latest'] else 'false'}</IsLatest>"
+                    f"<LastModified>{OSSVolume.http_time(e['mtime'])}</LastModified>")
+            if not e["delete_marker"]:
+                body += (f"<ETag>&quot;{e['etag']}&quot;</ETag>"
+                         f"<Size>{e['size']}</Size>")
+            parts.append(f"<{tag}>{body}</{tag}>")
+        return Response.xml(
+            f"<ListVersionsResult><Name>{esc(bucket)}</Name>"
+            f"{''.join(parts)}</ListVersionsResult>")
+
+    # -- lifecycle -----------------------------------------------------------------
+    #
+    # Rules persist as a JSON bucket xattr; apply_lifecycle() is the expiry
+    # sweeper the deployment pumps (the reference runs it inside objectnode's
+    # lifecycle service).
+
+    def get_bucket_lifecycle(self, req: Request):
+        bucket = req.params["bucket"]
+        self._check(req, bucket, ACTION_GET)
+        raw = self._vol(bucket).get_bucket_xattr(XATTR_LIFECYCLE)
+        if not raw:
+            raise S3Error(404, "NoSuchLifecycleConfiguration", bucket)
+        import json as _json
+
+        rules = _json.loads(raw)
+        inner = "".join(
+            f"<Rule><ID>{esc(r['id'])}</ID>"
+            f"<Filter><Prefix>{esc(r['prefix'])}</Prefix></Filter>"
+            f"<Status>{r['status']}</Status>"
+            f"<Expiration><Days>{r['days']}</Days></Expiration></Rule>"
+            for r in rules)
+        return Response.xml(
+            f"<LifecycleConfiguration>{inner}</LifecycleConfiguration>")
+
+    def put_bucket_lifecycle(self, req: Request):
+        bucket = req.params["bucket"]
+        self._check(req, bucket, ACTION_PUT)
+        root = _parse_xml(req.body)
+        rules = []
+        for rule in root.iter("Rule"):
+            exp = rule.find("Expiration")
+            days = _text(exp, "Days") if exp is not None else ""
+            if not days:
+                raise S3Error(400, "MalformedXML", "Expiration.Days required")
+            filt = rule.find("Filter")
+            prefix = _text(filt, "Prefix") if filt is not None else _text(rule, "Prefix")
+            try:
+                days_n = int(days)
+            except ValueError:
+                raise S3Error(400, "MalformedXML",
+                              f"Expiration.Days {days!r}") from None
+            rules.append({"id": _text(rule, "ID") or f"rule{len(rules)}",
+                          "prefix": prefix,
+                          "status": _text(rule, "Status") or "Enabled",
+                          "days": days_n})
+        if not rules:
+            raise S3Error(400, "MalformedXML", "no Rule")
+        import json as _json
+
+        self._vol(bucket).set_bucket_xattr(XATTR_LIFECYCLE,
+                                           _json.dumps(rules).encode())
+        return Response(200)
+
+    def delete_bucket_lifecycle(self, req: Request):
+        bucket = req.params["bucket"]
+        self._check(req, bucket, ACTION_DELETE)
+        self._vol(bucket).del_bucket_xattr(XATTR_LIFECYCLE)
+        return Response(204)
+
+    def apply_lifecycle(self, now: float | None = None) -> int:
+        """Expire objects per enabled rules; returns objects expired. The
+        deployment pumps this like the master's background checks."""
+        import json as _json
+        import time as _time
+
+        now = now if now is not None else _time.time()
+        expired = 0
+        for bucket in self.cluster.volume_names():
+            try:
+                vol = self._vol(bucket)
+            except S3Error:
+                continue
+            raw = vol.get_bucket_xattr(XATTR_LIFECYCLE)
+            if not raw:
+                continue
+            versioned = vol.versioning_status() == "Enabled"
+            for rule in _json.loads(raw):
+                if rule["status"] != "Enabled":
+                    continue
+                contents, _, _, _ = vol.list_objects(
+                    prefix=rule["prefix"], max_keys=100000)
+                cutoff = now - rule["days"] * 86400
+                for obj in contents:
+                    if obj["key"].endswith("/"):
+                        continue  # dir markers never expire (and can't archive)
+                    if obj["mtime"] <= cutoff:
+                        if versioned:
+                            vol.archive_current(obj["key"])
+                            vol.put_delete_marker(obj["key"])
+                        else:
+                            vol.delete_object(obj["key"])
+                        expired += 1
+        return expired

@@ -21,12 +21,25 @@ XATTR_CONTENT_TYPE = "oss:content-type"
 XATTR_USER_META = "oss:meta"
 XATTR_TAGGING = "oss:tagging"
 XATTR_DIR_MARKER = "oss:dir"
+XATTR_VERSIONING = "oss:versioning"  # bucket: "Enabled" | "Suspended"
+XATTR_VERSION_ID = "oss:version-id"  # current object's version id
+XATTR_DELETE_MARKER = "oss:delete-marker"
 
 DEFAULT_CONTENT_TYPE = "application/octet-stream"
+VERSIONS_ROOT = ".versions"  # hidden prefix hosting archived versions
 
 
 class NoSuchKey(Exception):
     pass
+
+
+class ReservedKey(Exception):
+    """Key addresses the hidden version store — not a legal object key."""
+
+
+def _guard_key(key: str):
+    if key == VERSIONS_ROOT or key.startswith(VERSIONS_ROOT + "/"):
+        raise ReservedKey(key)
 
 
 def _etag(data: bytes) -> str:
@@ -45,6 +58,7 @@ class OSSVolume:
 
     def put_object(self, key: str, data: bytes, content_type: str = "",
                    user_meta: dict | None = None, etag: str | None = None) -> str:
+        _guard_key(key)
         if key.endswith("/"):
             # directory marker object (the console/aws-cli "create folder" shape)
             ino_path = "/" + key.rstrip("/")
@@ -68,6 +82,7 @@ class OSSVolume:
     # -- read --------------------------------------------------------------------
 
     def info(self, key: str) -> dict:
+        _guard_key(key)
         path = "/" + key.rstrip("/")
         try:
             st = self.fs.stat(path)
@@ -106,6 +121,7 @@ class OSSVolume:
 
     def delete_object(self, key: str) -> None:
         """Idempotent like S3 DeleteObject (no error on missing key)."""
+        _guard_key(key)
         path = "/" + key.rstrip("/")
         try:
             st = self.fs.stat(path)
@@ -170,11 +186,184 @@ class OSSVolume:
     def del_bucket_xattr(self, key: str):
         self.fs.removexattr("/", key)
 
+    # -- versioning (objectnode versioning semantics) ------------------------------
+    #
+    # Archived versions live under the hidden /.versions/<quoted-key>/<vid>
+    # tree: an archive is ONE rename (the inode keeps its xattrs), never a data
+    # copy. Version ids are zero-padded hex timestamps, so lexicographic order
+    # IS recency order. A delete under versioning archives the current object
+    # and records a delete-marker entry.
+
+    def versioning_status(self) -> str:
+        raw = self.get_bucket_xattr(XATTR_VERSIONING)
+        return raw.decode() if raw else ""
+
+    def set_versioning(self, status: str):
+        if status not in ("Enabled", "Suspended"):
+            raise ValueError(f"bad versioning status {status!r}")
+        self.set_bucket_xattr(XATTR_VERSIONING, status.encode())
+
+    @staticmethod
+    def new_version_id() -> str:
+        return f"{time.time_ns():020x}"
+
+    def _vdir(self, key: str) -> str:
+        import urllib.parse
+
+        return f"/{VERSIONS_ROOT}/" + urllib.parse.quote(key, safe="")
+
+    def archive_current(self, key: str) -> str | None:
+        """Move the live object into the version store; returns its version id
+        (the one it carried, or a fresh 'null'-era id), None if absent."""
+        path = "/" + key
+        try:
+            st = self.fs.stat(path)
+        except FsError:
+            return None
+        if st["is_dir"]:
+            return None
+        try:
+            vid = self.fs.getxattr(path, XATTR_VERSION_ID).decode()
+        except FsError:
+            vid = self.new_version_id()
+        self.fs.mkdirs(self._vdir(key))
+        self.fs.rename(path, f"{self._vdir(key)}/{vid}")
+        self._prune_empty_parents(path)
+        return vid
+
+    def put_delete_marker(self, key: str) -> str:
+        vid = self.new_version_id()
+        self.fs.mkdirs(self._vdir(key))
+        marker = f"{self._vdir(key)}/{vid}"
+        self.fs.write_file(marker, b"")
+        self.fs.setxattr(marker, XATTR_DELETE_MARKER, b"1")
+        return vid
+
+    def list_versions(self, prefix: str = "") -> list[dict]:
+        """All versions of all keys, newest first per key, currents included."""
+        import urllib.parse
+
+        out: list[dict] = []
+        keys: set[str] = set()
+        try:
+            names = self.fs.readdir("/" + VERSIONS_ROOT)
+        except FsError:
+            names = []
+        for quoted in names:
+            key = urllib.parse.unquote(quoted)
+            if prefix and not key.startswith(prefix):
+                continue
+            keys.add(key)
+        contents, _, _, _ = self.list_objects(prefix=prefix, max_keys=100000)
+        current_by_key = {o["key"]: o for o in contents}
+        for key in sorted(keys | set(current_by_key)):
+            entries = []
+            cur = current_by_key.get(key)
+            if cur is not None:
+                vid = "null"
+                try:
+                    vid = self.fs.getxattr("/" + key, XATTR_VERSION_ID).decode()
+                except FsError:
+                    pass
+                entries.append({"key": key, "version_id": vid, "is_latest": True,
+                                "delete_marker": False, "size": cur["size"],
+                                "mtime": cur["mtime"],
+                                "etag": cur.get("etag", "")})
+            vdir = self._vdir(key)
+            try:
+                vids = sorted(self.fs.readdir(vdir), reverse=True)
+            except FsError:
+                vids = []
+            for i, vid in enumerate(vids):
+                vp = f"{vdir}/{vid}"
+                st = self.fs.stat(vp)
+                marker = False
+                try:
+                    self.fs.getxattr(vp, XATTR_DELETE_MARKER)
+                    marker = True
+                except FsError:
+                    pass
+                etag = ""
+                try:
+                    etag = self.fs.getxattr(vp, XATTR_ETAG).decode()
+                except FsError:
+                    pass
+                entries.append({"key": key, "version_id": vid,
+                                "is_latest": cur is None and i == 0,
+                                "delete_marker": marker, "size": st["size"],
+                                "mtime": st["mtime"], "etag": etag})
+            out.extend(entries)
+        return out
+
+    def _current_vid(self, key: str) -> str | None:
+        try:
+            return self.fs.getxattr("/" + key, XATTR_VERSION_ID).decode()
+        except FsError:
+            return None
+
+    def stat_version(self, key: str, version_id: str) -> dict:
+        """Metadata of one version (current or archived) WITHOUT reading its
+        body; raises NoSuchKey if absent or a delete marker."""
+        if version_id in ("null", self._current_vid(key)):
+            return self.info(key)
+        vp = f"{self._vdir(key)}/{version_id}"
+        try:
+            st = self.fs.stat(vp)
+        except FsError:
+            raise NoSuchKey(f"{key}?versionId={version_id}") from None
+        try:
+            self.fs.getxattr(vp, XATTR_DELETE_MARKER)
+            raise NoSuchKey(f"{key}?versionId={version_id} is a delete marker")
+        except FsError:
+            pass
+        info = {"key": key, "size": st["size"], "mtime": st["mtime"],
+                "is_dir": False, "etag": "", "meta": {},
+                "content_type": DEFAULT_CONTENT_TYPE}
+        for xk, field in ((XATTR_ETAG, "etag"), (XATTR_CONTENT_TYPE, "content_type")):
+            try:
+                info[field] = self.fs.getxattr(vp, xk).decode()
+            except FsError:
+                pass
+        return info
+
+    def read_version(self, key: str, version_id: str, offset: int = 0,
+                     size: int | None = None) -> bytes:
+        if version_id in ("null", self._current_vid(key)):
+            return self.get_object(key, offset, size)
+        vp = f"{self._vdir(key)}/{version_id}"
+        try:
+            return self.fs.read_file(vp, offset, size)
+        except FsError:
+            raise NoSuchKey(f"{key}?versionId={version_id}") from None
+
+    def get_version(self, key: str, version_id: str) -> tuple[bytes, dict]:
+        info = self.stat_version(key, version_id)
+        return self.read_version(key, version_id), info
+
+    def delete_version(self, key: str, version_id: str) -> None:
+        """Permanently remove one version (current or archived); idempotent."""
+        cur_vid = self._current_vid(key)
+        if version_id == cur_vid or (version_id == "null" and cur_vid is None):
+            self.delete_object(key)
+            return
+        vp = f"{self._vdir(key)}/{version_id}"
+        try:
+            self.fs.unlink(vp)
+        except FsError:
+            return
+        try:
+            if not self.fs.readdir(self._vdir(key)):
+                self.fs.rmdir(self._vdir(key))
+        except FsError:
+            pass
+
     # -- listing -----------------------------------------------------------------
 
     def _walk(self, dirpath: str, out: list[dict]):
         """DFS in lexicographic order; emits files and dir-marker dirs."""
         for name in sorted(self.fs.readdir(dirpath or "/")):
+            if dirpath == "" and name == VERSIONS_ROOT:
+                continue  # the version store is not part of the namespace
             child = f"{dirpath}/{name}"
             st = self.fs.stat(child)
             key = child.lstrip("/")

@@ -106,6 +106,11 @@ class ProcCluster:
                 "walDir": os.path.join(self.root, f"dn{i}", "wal")}
 
     def spawn(self, name: str, cfg: dict) -> subprocess.Popen:
+        # the platform request rides the CONFIG, not the env: a sitecustomize-
+        # registered accelerator plugin rewrites JAX_PLATFORMS before main()
+        # runs, so env-only requests are silently lost (test daemons must run
+        # on CPU, never on a proxied accelerator's health)
+        cfg.setdefault("jaxPlatform", "cpu")
         path = os.path.join(self.root, f"{name}.json")
         with open(path, "w") as f:
             json.dump(cfg, f)

@@ -1,0 +1,353 @@
+"""S3 breadth: versioning, lifecycle, UploadPartCopy, presigned URLs.
+
+Reference: objectnode/router.go's versioning/lifecycle/part-copy routes and
+query-auth (presigned) verification. Same harness as test_objectnode: real
+FsCluster + live HTTP + real signatures.
+"""
+
+import http.client
+import time
+import xml.etree.ElementTree as ET
+
+import pytest
+
+from chubaofs_tpu.deploy import FsCluster
+from chubaofs_tpu.objectnode import ObjectNode
+from chubaofs_tpu.objectnode.auth import presign_v2, presign_v4, sign_v4
+from chubaofs_tpu.rpc import RPCServer
+
+AK, SK = "testak", "testsk"
+
+
+@pytest.fixture(scope="module")
+def s3env(tmp_path_factory):
+    root = tmp_path_factory.mktemp("s3breadth")
+    cluster = FsCluster(str(root), n_nodes=3, blob_nodes=6, data_nodes=0)
+    node = ObjectNode(cluster, users={AK: {"secret_key": SK, "uid": "alice"}})
+    srv = RPCServer(node.router).start()
+    yield srv, node
+    srv.stop()
+    cluster.close()
+
+
+def req(s3, method, path, body=b"", headers=None, raw_query=""):
+    host = s3.addr
+    hdrs = {"host": host}
+    hdrs.update(headers or {})
+    hdrs = sign_v4(method, path, raw_query, hdrs, AK, SK, payload=body)
+    target = path + (f"?{raw_query}" if raw_query else "")
+    conn = http.client.HTTPConnection(host, timeout=30)
+    try:
+        conn.request(method, target, body=body or None, headers=hdrs)
+        resp = conn.getresponse()
+        return resp.status, dict(resp.getheaders()), resp.read()
+    finally:
+        conn.close()
+
+
+def raw_req(s3, method, target):
+    """No Authorization header — query-auth only (presigned URLs)."""
+    conn = http.client.HTTPConnection(s3.addr, timeout=30)
+    try:
+        conn.request(method, target, headers={"host": s3.addr})
+        resp = conn.getresponse()
+        return resp.status, resp.read()
+    finally:
+        conn.close()
+
+
+def xml_of(body):
+    return ET.fromstring(body.decode())
+
+
+# -- versioning ----------------------------------------------------------------
+
+
+def test_versioning_roundtrip(s3env):
+    s3, _ = s3env
+    assert req(s3, "PUT", "/verbkt")[0] == 200
+    body = b"<VersioningConfiguration><Status>Enabled</Status></VersioningConfiguration>"
+    assert req(s3, "PUT", "/verbkt", body=body, raw_query="versioning")[0] == 200
+    status, _, got = req(s3, "GET", "/verbkt", raw_query="versioning")
+    assert status == 200 and b"<Status>Enabled</Status>" in got
+
+    s1, h1, _ = req(s3, "PUT", "/verbkt/doc", body=b"version-one")
+    assert s1 == 200
+    v1 = h1["x-amz-version-id"]
+    s2, h2, _ = req(s3, "PUT", "/verbkt/doc", body=b"version-two!")
+    v2 = h2["x-amz-version-id"]
+    assert v1 != v2
+
+    # latest wins on plain GET; versionId reaches the archive
+    assert req(s3, "GET", "/verbkt/doc")[2] == b"version-two!"
+    status, _, old = req(s3, "GET", "/verbkt/doc", raw_query=f"versionId={v1}")
+    assert status == 200 and old == b"version-one"
+
+    # list versions: two entries, newest is latest
+    status, _, body = req(s3, "GET", "/verbkt", raw_query="versions")
+    root = xml_of(body)
+    versions = root.findall("Version")
+    assert [v.findtext("VersionId") for v in versions] == [v2, v1]
+    assert versions[0].findtext("IsLatest") == "true"
+
+
+def test_versioned_delete_marker(s3env):
+    s3, _ = s3env
+    req(s3, "PUT", "/verbkt2")
+    body = b"<VersioningConfiguration><Status>Enabled</Status></VersioningConfiguration>"
+    req(s3, "PUT", "/verbkt2", body=body, raw_query="versioning")
+    _, h, _ = req(s3, "PUT", "/verbkt2/k", body=b"data")
+    vid = h["x-amz-version-id"]
+
+    status, h, _ = req(s3, "DELETE", "/verbkt2/k")
+    assert status == 204 and h.get("x-amz-delete-marker") == "true"
+    # plain GET 404s, versioned GET still serves the archived bytes
+    assert req(s3, "GET", "/verbkt2/k")[0] == 404
+    status, _, got = req(s3, "GET", "/verbkt2/k", raw_query=f"versionId={vid}")
+    assert status == 200 and got == b"data"
+    # the marker appears in the version listing
+    _, _, body = req(s3, "GET", "/verbkt2", raw_query="versions")
+    assert xml_of(body).find("DeleteMarker") is not None
+    # permanently removing the archived version
+    assert req(s3, "DELETE", "/verbkt2/k",
+               raw_query=f"versionId={vid}")[0] == 204
+    assert req(s3, "GET", "/verbkt2/k",
+               raw_query=f"versionId={vid}")[0] == 404
+
+
+def test_versions_hidden_from_listing(s3env):
+    s3, _ = s3env
+    req(s3, "PUT", "/verbkt3")
+    body = b"<VersioningConfiguration><Status>Enabled</Status></VersioningConfiguration>"
+    req(s3, "PUT", "/verbkt3", body=body, raw_query="versioning")
+    req(s3, "PUT", "/verbkt3/a", body=b"1")
+    req(s3, "PUT", "/verbkt3/a", body=b"2")
+    _, _, body = req(s3, "GET", "/verbkt3")
+    keys = [c.findtext("Key") for c in xml_of(body).findall("Contents")]
+    assert keys == ["a"]  # the .versions store never leaks into ListObjects
+
+
+# -- lifecycle -------------------------------------------------------------------
+
+
+LC = (b"<LifecycleConfiguration><Rule><ID>exp</ID>"
+      b"<Filter><Prefix>tmp/</Prefix></Filter><Status>Enabled</Status>"
+      b"<Expiration><Days>1</Days></Expiration></Rule></LifecycleConfiguration>")
+
+
+def test_lifecycle_config_roundtrip(s3env):
+    s3, _ = s3env
+    req(s3, "PUT", "/lcbkt")
+    assert req(s3, "GET", "/lcbkt", raw_query="lifecycle")[0] == 404
+    assert req(s3, "PUT", "/lcbkt", body=LC, raw_query="lifecycle")[0] == 200
+    status, _, body = req(s3, "GET", "/lcbkt", raw_query="lifecycle")
+    assert status == 200
+    rule = xml_of(body).find("Rule")
+    assert rule.findtext("ID") == "exp"
+    assert rule.find("Expiration").findtext("Days") == "1"
+    assert req(s3, "DELETE", "/lcbkt", raw_query="lifecycle")[0] == 204
+    assert req(s3, "GET", "/lcbkt", raw_query="lifecycle")[0] == 404
+
+
+def test_lifecycle_expiry_sweeper(s3env):
+    s3, node = s3env
+    req(s3, "PUT", "/lcbkt2")
+    req(s3, "PUT", "/lcbkt2", body=LC, raw_query="lifecycle")
+    req(s3, "PUT", "/lcbkt2/tmp/old", body=b"expired soon")
+    req(s3, "PUT", "/lcbkt2/keep/me", body=b"not matching prefix")
+    # pretend 2 days passed: everything under tmp/ ages out
+    expired = node.apply_lifecycle(now=time.time() + 2 * 86400)
+    assert expired >= 1
+    assert req(s3, "GET", "/lcbkt2/tmp/old")[0] == 404
+    assert req(s3, "GET", "/lcbkt2/keep/me")[0] == 200
+
+
+# -- UploadPartCopy ---------------------------------------------------------------
+
+
+def test_upload_part_copy(s3env):
+    s3, _ = s3env
+    req(s3, "PUT", "/cpbkt")
+    src = bytes(range(256)) * 1024  # 256 KiB
+    assert req(s3, "PUT", "/cpbkt/src", body=src)[0] == 200
+
+    _, _, body = req(s3, "POST", "/cpbkt/dst", raw_query="uploads")
+    upload_id = xml_of(body).findtext("UploadId")
+
+    # part 1: full-object copy; part 2: ranged copy; part 3: plain bytes
+    status, _, body = req(s3, "PUT", "/cpbkt/dst",
+                          headers={"x-amz-copy-source": "/cpbkt/src"},
+                          raw_query=f"partNumber=1&uploadId={upload_id}")
+    assert status == 200
+    etag1 = xml_of(body).findtext("ETag").strip('"')
+    status, _, body = req(s3, "PUT", "/cpbkt/dst",
+                          headers={"x-amz-copy-source": "/cpbkt/src",
+                                   "x-amz-copy-source-range": "bytes=0-65535"},
+                          raw_query=f"partNumber=2&uploadId={upload_id}")
+    assert status == 200
+    etag2 = xml_of(body).findtext("ETag").strip('"')
+    status, _, _ = req(s3, "PUT", "/cpbkt/dst", body=b"tail",
+                       raw_query=f"partNumber=3&uploadId={upload_id}")
+    assert status == 200
+    _, h, _ = req(s3, "PUT", "/cpbkt/dst", body=b"tail",
+                  raw_query=f"partNumber=3&uploadId={upload_id}")
+    etag3 = h["ETag"].strip('"')
+
+    complete = (
+        "<CompleteMultipartUpload>"
+        f"<Part><PartNumber>1</PartNumber><ETag>{etag1}</ETag></Part>"
+        f"<Part><PartNumber>2</PartNumber><ETag>{etag2}</ETag></Part>"
+        f"<Part><PartNumber>3</PartNumber><ETag>{etag3}</ETag></Part>"
+        "</CompleteMultipartUpload>").encode()
+    status, _, _ = req(s3, "POST", "/cpbkt/dst", body=complete,
+                       raw_query=f"uploadId={upload_id}")
+    assert status == 200
+    _, _, got = req(s3, "GET", "/cpbkt/dst")
+    assert got == src + src[:65536] + b"tail"
+
+
+def test_upload_part_copy_bad_range(s3env):
+    s3, _ = s3env
+    req(s3, "PUT", "/cpbkt2")
+    req(s3, "PUT", "/cpbkt2/s", body=b"x" * 100)
+    _, _, body = req(s3, "POST", "/cpbkt2/d", raw_query="uploads")
+    uid = xml_of(body).findtext("UploadId")
+    status, _, body = req(s3, "PUT", "/cpbkt2/d",
+                          headers={"x-amz-copy-source": "/cpbkt2/s",
+                                   "x-amz-copy-source-range": "bytes=0-1000"},
+                          raw_query=f"partNumber=1&uploadId={uid}")
+    assert status == 416
+
+
+# -- presigned URLs ---------------------------------------------------------------
+
+
+def test_presigned_v4_get(s3env):
+    s3, _ = s3env
+    req(s3, "PUT", "/psbkt")
+    req(s3, "PUT", "/psbkt/obj", body=b"presigned payload")
+    q = presign_v4("GET", "/psbkt/obj", s3.addr, AK, SK, expires=300)
+    status, got = raw_req(s3, "GET", "/psbkt/obj?" + q)
+    assert status == 200 and got == b"presigned payload"
+
+
+def test_presigned_v4_expired(s3env):
+    s3, _ = s3env
+    old = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime(time.time() - 3600))
+    q = presign_v4("GET", "/psbkt/obj", s3.addr, AK, SK, expires=60,
+                   amz_date=old)
+    status, body = raw_req(s3, "GET", "/psbkt/obj?" + q)
+    assert status == 403 and b"SignatureDoesNotMatch" in body
+
+
+def test_presigned_v4_tamper(s3env):
+    s3, _ = s3env
+    q = presign_v4("GET", "/psbkt/obj", s3.addr, AK, SK, expires=300)
+    status, _ = raw_req(s3, "GET", "/psbkt/other?" + q)  # different key
+    assert status == 403
+
+
+def test_presigned_v2_get(s3env):
+    s3, _ = s3env
+    q = presign_v2("GET", "/psbkt/obj", AK, SK, int(time.time()) + 300)
+    status, got = raw_req(s3, "GET", "/psbkt/obj?" + q)
+    assert status == 200 and got == b"presigned payload"
+    q = presign_v2("GET", "/psbkt/obj", AK, SK, int(time.time()) - 10)
+    assert raw_req(s3, "GET", "/psbkt/obj?" + q)[0] == 403
+
+
+def test_versioning_covers_copy_batch_delete_and_multipart(s3env):
+    """CopyObject, DeleteObjects, and CompleteMultipartUpload honor versioning
+    the same way single-key PUT/DELETE do."""
+    s3, _ = s3env
+    req(s3, "PUT", "/verbkt4")
+    body = b"<VersioningConfiguration><Status>Enabled</Status></VersioningConfiguration>"
+    req(s3, "PUT", "/verbkt4", body=body, raw_query="versioning")
+    _, h, _ = req(s3, "PUT", "/verbkt4/k", body=b"original")
+    v1 = h["x-amz-version-id"]
+
+    # copy over k: the original survives as v1
+    req(s3, "PUT", "/verbkt4/src", body=b"copied-bytes")
+    status, _, _ = req(s3, "PUT", "/verbkt4/k",
+                       headers={"x-amz-copy-source": "/verbkt4/src"})
+    assert status == 200
+    assert req(s3, "GET", "/verbkt4/k")[2] == b"copied-bytes"
+    assert req(s3, "GET", "/verbkt4/k",
+               raw_query=f"versionId={v1}")[2] == b"original"
+
+    # batch delete leaves a marker, not a destructive unlink
+    dele = b"<Delete><Object><Key>k</Key></Object></Delete>"
+    req(s3, "POST", "/verbkt4", body=dele, raw_query="delete")
+    assert req(s3, "GET", "/verbkt4/k")[0] == 404
+    assert req(s3, "GET", "/verbkt4/k",
+               raw_query=f"versionId={v1}")[2] == b"original"
+
+    # multipart completion over an existing key archives it first
+    _, h, _ = req(s3, "PUT", "/verbkt4/m", body=b"before-mpu")
+    vm = h["x-amz-version-id"]
+    _, _, ibody = req(s3, "POST", "/verbkt4/m", raw_query="uploads")
+    uid = xml_of(ibody).findtext("UploadId")
+    _, hp, _ = req(s3, "PUT", "/verbkt4/m", body=b"part-one",
+                   raw_query=f"partNumber=1&uploadId={uid}")
+    etag = hp["ETag"].strip('"')
+    comp = (f"<CompleteMultipartUpload><Part><PartNumber>1</PartNumber>"
+            f"<ETag>{etag}</ETag></Part></CompleteMultipartUpload>").encode()
+    assert req(s3, "POST", "/verbkt4/m", body=comp,
+               raw_query=f"uploadId={uid}")[0] == 200
+    assert req(s3, "GET", "/verbkt4/m")[2] == b"part-one"
+    assert req(s3, "GET", "/verbkt4/m",
+               raw_query=f"versionId={vm}")[2] == b"before-mpu"
+
+
+def test_suspended_versioning_retains_real_versions(s3env):
+    s3, _ = s3env
+    req(s3, "PUT", "/verbkt5")
+    en = b"<VersioningConfiguration><Status>Enabled</Status></VersioningConfiguration>"
+    su = b"<VersioningConfiguration><Status>Suspended</Status></VersioningConfiguration>"
+    req(s3, "PUT", "/verbkt5", body=en, raw_query="versioning")
+    _, h, _ = req(s3, "PUT", "/verbkt5/k", body=b"v-real")
+    v_real = h["x-amz-version-id"]
+    req(s3, "PUT", "/verbkt5", body=su, raw_query="versioning")
+    # suspended PUT: real version retained, write becomes the null version
+    _, h, _ = req(s3, "PUT", "/verbkt5/k", body=b"null-one")
+    assert "x-amz-version-id" not in h
+    _, h, _ = req(s3, "PUT", "/verbkt5/k", body=b"null-two")
+    assert req(s3, "GET", "/verbkt5/k")[2] == b"null-two"
+    assert req(s3, "GET", "/verbkt5/k",
+               raw_query=f"versionId={v_real}")[2] == b"v-real"
+
+
+def test_reserved_version_store_key_rejected(s3env):
+    s3, _ = s3env
+    req(s3, "PUT", "/verbkt6")
+    status, _, body = req(s3, "PUT", "/verbkt6/.versions/forged/1", body=b"x")
+    assert status == 400 and b"InvalidArgument" in body
+    assert req(s3, "GET", "/verbkt6/.versions/forged/1")[0] == 400
+    assert req(s3, "DELETE", "/verbkt6/.versions/forged/1")[0] == 400
+
+
+def test_malformed_lifecycle_xml_is_400(s3env):
+    s3, _ = s3env
+    req(s3, "PUT", "/lcbkt3")
+    bad = (b"<LifecycleConfiguration><Rule><Status>Enabled</Status>"
+           b"<Expiration><Days>ten</Days></Expiration></Rule>"
+           b"</LifecycleConfiguration>")
+    status, _, body = req(s3, "PUT", "/lcbkt3", body=bad, raw_query="lifecycle")
+    assert status == 400 and b"MalformedXML" in body
+    status, _, body = req(s3, "PUT", "/lcbkt3", body=b"<notxml",
+                          raw_query="lifecycle")
+    assert status == 400 and b"MalformedXML" in body
+
+
+def test_versioned_get_supports_range(s3env):
+    s3, _ = s3env
+    req(s3, "PUT", "/verbkt7")
+    en = b"<VersioningConfiguration><Status>Enabled</Status></VersioningConfiguration>"
+    req(s3, "PUT", "/verbkt7", body=en, raw_query="versioning")
+    _, h, _ = req(s3, "PUT", "/verbkt7/k", body=b"0123456789")
+    vid = h["x-amz-version-id"]
+    req(s3, "PUT", "/verbkt7/k", body=b"new-content")
+    status, hh, got = req(s3, "GET", "/verbkt7/k", raw_query=f"versionId={vid}",
+                          headers={"range": "bytes=2-5"})
+    assert status == 206 and got == b"2345"
+    assert hh["Content-Range"] == "bytes 2-5/10"
