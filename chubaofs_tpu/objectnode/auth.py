@@ -172,20 +172,20 @@ def verify_presigned_v4(req, secret_key: str) -> bool:
     """Verify a query-auth (presigned) V4 request, including expiry."""
     import time
 
+    import calendar
+
     q = {k: v[0] for k, v in req.query.items() if v}
     try:
         cred = q["X-Amz-Credential"].split("/")
         amz_date, expires = q["X-Amz-Date"], int(q["X-Amz-Expires"])
         signed_headers = q["X-Amz-SignedHeaders"].split(";")
         sig = q["X-Amz-Signature"]
+        t0 = calendar.timegm(time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
+        date, region, service = cred[1], cred[2], cred[3]
     except (KeyError, IndexError, ValueError):
-        return False
-    import calendar
-
-    t0 = calendar.timegm(time.strptime(amz_date, "%Y%m%dT%H%M%SZ"))
+        return False  # malformed presigned params = failed auth, never a 500
     if time.time() > t0 + expires:
         return False
-    date, region, service = cred[1], cred[2], cred[3]
     raw = _canonical_query(req.raw_query, drop=frozenset(("X-Amz-Signature",)))
     creq = canonical_request_v4(req.method, req.path, raw, req.headers,
                                 signed_headers, UNSIGNED_PAYLOAD)
@@ -243,14 +243,20 @@ def verify_v2(req, secret_key: str) -> bool:
 
 
 def presign_v2(method: str, path: str, access_key: str, secret_key: str,
-               expires_at: int) -> str:
-    """Query string of a V2 presigned URL (AWSAccessKeyId/Expires/Signature)."""
+               expires_at: int, subresource_query: str = "") -> str:
+    """Query string of a V2 presigned URL (AWSAccessKeyId/Expires/Signature).
+
+    `subresource_query` is any signed subresource the URL targets (e.g.
+    "versionId=x"); it is part of the canonical resource, so the URL holder
+    cannot retarget the signature at a different subresource."""
     path = urllib.parse.unquote(path)
-    sts = f"{method.upper()}\n\n\n{expires_at}\n{_canonical_resource_v2(path, '')}"
+    resource = _canonical_resource_v2(path, subresource_query)
+    sts = f"{method.upper()}\n\n\n{expires_at}\n{resource}"
     sig = b64encode(hmac.new(secret_key.encode(), sts.encode(),
                              hashlib.sha1).digest()).decode()
-    return urllib.parse.urlencode(
-        {"AWSAccessKeyId": access_key, "Expires": expires_at, "Signature": sig})
+    out = {"AWSAccessKeyId": access_key, "Expires": expires_at, "Signature": sig}
+    q = urllib.parse.urlencode(out)
+    return f"{subresource_query}&{q}" if subresource_query else q
 
 
 def verify_presigned_v2(req, secret_key: str) -> bool:
@@ -263,8 +269,11 @@ def verify_presigned_v2(req, secret_key: str) -> bool:
         return False
     if time.time() > expires_at:
         return False
-    sts = (f"{req.method.upper()}\n\n\n{expires_at}\n"
-           f"{_canonical_resource_v2(req.path, '')}")
+    # the canonical resource includes the request's signed subresources
+    # (auth params like Signature/Expires aren't in _V2_SUBRESOURCES, so the
+    # filter drops them automatically)
+    resource = _canonical_resource_v2(req.path, req.raw_query)
+    sts = f"{req.method.upper()}\n\n\n{expires_at}\n{resource}"
     want = b64encode(hmac.new(secret_key.encode(), sts.encode(),
                               hashlib.sha1).digest()).decode()
     return hmac.compare_digest(want, sig)

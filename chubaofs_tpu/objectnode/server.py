@@ -459,10 +459,8 @@ class ObjectNode:
         self._check(req, bucket, ACTION_GET, key)
         vol = self._vol(bucket)
         vid = req.q("versionId")
-        if vid:
-            _, info = vol.get_version(key, vid)
-        else:
-            info = vol.info(key)
+        # stat only — HEAD must never pay a whole-object read
+        info = vol.stat_version(key, vid) if vid else vol.info(key)
         headers = self._object_headers(info)
         headers["Content-Length"] = str(info["size"])
         return Response(200, headers)
@@ -475,35 +473,38 @@ class ObjectNode:
         if vid:
             vol.delete_version(key, vid)
             return Response(204, {"x-amz-version-id": vid})
-        status = vol.versioning_status()
-        if status:
-            # versioned delete: retain history, record a marker. Suspended
-            # removes the null current outright but still keeps real versions.
-            if status == "Enabled" or vol._current_vid(key) is not None:
-                vol.archive_current(key)
-            else:
-                vol.delete_object(key)
-            marker_vid = vol.put_delete_marker(key)
+        marker_vid = self._versioned_delete(vol, key)
+        if marker_vid:
             return Response(204, {"x-amz-delete-marker": "true",
                                   "x-amz-version-id": marker_vid})
-        vol.delete_object(key)
         return Response(204)
+
+    @staticmethod
+    def _versioned_delete(vol: OSSVolume, key: str) -> str | None:
+        """Shared delete semantics for DeleteObject AND batch DeleteObjects:
+        under versioning, retain history and record a marker (Suspended still
+        removes the null current but keeps real versions); unversioned buckets
+        delete outright. Returns the marker's version id, or None."""
+        status = vol.versioning_status()
+        if not status:
+            vol.delete_object(key)
+            return None
+        if status == "Enabled" or vol._current_vid(key) is not None:
+            vol.archive_current(key)
+        else:
+            vol.delete_object(key)
+        return vol.put_delete_marker(key)
 
     def delete_objects(self, req: Request):
         bucket = req.params["bucket"]
         self._check(req, bucket, ACTION_DELETE)
         vol = self._vol(bucket)
         root = _parse_xml(req.body)
-        versioned = vol.versioning_status() == "Enabled"
         deleted = []
         for obj in root.iter("Object"):
             key = _text(obj, "Key")
             if key:
-                if versioned:
-                    vol.archive_current(key)
-                    vol.put_delete_marker(key)
-                else:
-                    vol.delete_object(key)
+                self._versioned_delete(vol, key)
                 deleted.append(key)
         body = "".join(f"<Deleted><Key>{esc(k)}</Key></Deleted>" for k in deleted)
         return Response.xml(f"<DeleteResult>{body}</DeleteResult>")

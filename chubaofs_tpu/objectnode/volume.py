@@ -301,10 +301,16 @@ class OSSVolume:
         except FsError:
             return None
 
+    def _is_current(self, key: str, version_id: str) -> bool:
+        """'null' names the current object only when it carries NO real
+        version id (S3 null-version identity)."""
+        cur = self._current_vid(key)
+        return version_id == cur or (version_id == "null" and cur is None)
+
     def stat_version(self, key: str, version_id: str) -> dict:
         """Metadata of one version (current or archived) WITHOUT reading its
         body; raises NoSuchKey if absent or a delete marker."""
-        if version_id in ("null", self._current_vid(key)):
+        if self._is_current(key, version_id):
             return self.info(key)
         vp = f"{self._vdir(key)}/{version_id}"
         try:
@@ -328,7 +334,7 @@ class OSSVolume:
 
     def read_version(self, key: str, version_id: str, offset: int = 0,
                      size: int | None = None) -> bytes:
-        if version_id in ("null", self._current_vid(key)):
+        if self._is_current(key, version_id):
             return self.get_object(key, offset, size)
         vp = f"{self._vdir(key)}/{version_id}"
         try:
@@ -341,10 +347,12 @@ class OSSVolume:
         return self.read_version(key, version_id), info
 
     def delete_version(self, key: str, version_id: str) -> None:
-        """Permanently remove one version (current or archived); idempotent."""
-        cur_vid = self._current_vid(key)
-        if version_id == cur_vid or (version_id == "null" and cur_vid is None):
+        """Permanently remove one version (current or archived); idempotent.
+        Deleting the CURRENT version promotes the newest archived non-marker
+        version back to live (S3: the previous version becomes latest)."""
+        if self._is_current(key, version_id):
             self.delete_object(key)
+            self._promote_newest(key)
             return
         vp = f"{self._vdir(key)}/{version_id}"
         try:
@@ -354,6 +362,34 @@ class OSSVolume:
         try:
             if not self.fs.readdir(self._vdir(key)):
                 self.fs.rmdir(self._vdir(key))
+        except FsError:
+            pass
+
+    def _promote_newest(self, key: str) -> None:
+        """Move the newest archived version back to the live path — unless it
+        is a delete marker (then the key stays logically deleted)."""
+        vdir = self._vdir(key)
+        try:
+            vids = sorted(self.fs.readdir(vdir), reverse=True)
+        except FsError:
+            return
+        if not vids:
+            return
+        vp = f"{vdir}/{vids[0]}"
+        try:
+            self.fs.getxattr(vp, XATTR_DELETE_MARKER)
+            return  # a marker stays latest: the key remains deleted
+        except FsError:
+            pass
+        path = "/" + key
+        parent = path.rsplit("/", 1)[0]
+        if parent:
+            self.fs.mkdirs(parent)
+        self.fs.rename(vp, path)  # xattrs (etag, vid, meta) travel with it
+        self.fs.setxattr(path, XATTR_VERSION_ID, vids[0].encode())
+        try:
+            if not self.fs.readdir(vdir):
+                self.fs.rmdir(vdir)
         except FsError:
             pass
 

@@ -60,6 +60,10 @@ class Msg:
     snap_index: int = 0
     snap_term: int = 0
     snap_data: bytes = b""
+    # merged cross-group heartbeat (group_hb/group_hb_resp): [gid, term,
+    # commit] triples / [gid, term] stale pairs — ONE message per peer pair
+    # per tick regardless of group count (tiglabs raft README:18)
+    hb: list = field(default_factory=list)
 
 
 class RaftCore:
@@ -91,6 +95,9 @@ class RaftCore:
         self._committed: list[tuple[int, Entry]] = []
         # set by the server when the sm can produce a snapshot for laggards
         self.snapshot_fn = None  # () -> (index, term, bytes)
+        # peers due a liveness heartbeat this tick; the SERVER merges these
+        # across groups into one group_hb per peer (tiglabs README:18)
+        self.pending_hb: list[int] = []
 
     # -- helpers ------------------------------------------------------------
 
@@ -124,9 +131,38 @@ class RaftCore:
         if self.role == ROLE_LEADER:
             if self.elapsed >= HEARTBEAT_TICKS:
                 self.elapsed = 0
-                self._broadcast_append()
+                for p in self.peers:
+                    # merged path only for peers whose match is VERIFIED (an
+                    # append_resp proved the prefix); next_index alone can be
+                    # optimistic (fresh members, post-election defaults)
+                    if self.match_index.get(p, 0) < self.last_index:
+                        # laggard/unverified: real replication traffic
+                        self._send_append(p)
+                    else:
+                        # quiescent: liveness only — merged across groups by
+                        # the server so 1,000 partitions != 1,000 messages
+                        self.pending_hb.append(p)
         elif self.elapsed >= self.election_timeout:
             self._campaign()
+
+    def step_group_hb(self, src: int, term: int, commit: int) -> bool:
+        """One group's slice of a merged heartbeat. Returns False when the
+        sender's term is stale (the server reports it back so the old leader
+        steps down). Safe without a log-prefix check: a leader only puts a
+        peer on the merged path once match_index == last_index, which an
+        append_resp verified; any divergence since implies a higher term,
+        caught here."""
+        if term < self.term:
+            return False
+        if term > self.term:
+            self._become_follower(term, src)
+        self.role = ROLE_FOLLOWER
+        self.leader = src
+        self.elapsed = 0
+        if commit > self.commit:
+            self.commit = min(commit, self.last_index)
+            self._emit_committed()
+        return True
 
     def propose(self, data) -> int:
         if self.role != ROLE_LEADER:

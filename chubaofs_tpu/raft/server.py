@@ -230,16 +230,27 @@ class MultiRaft:
     def tick(self):
         """One logical clock tick for every group; flush I/O.
 
+        Quiescent leaders' liveness beats are MERGED: one group_hb message per
+        peer carries every group's (term, commit) slice, so heartbeat traffic
+        scales with peers, not partitions (tiglabs raft README:18).
+
         Outbound messages are sent AFTER the node lock is released: delivery
         acquires the destination node's lock, and holding two node locks at
         once would deadlock concurrent datanode/metanode handler threads."""
         out: list[Msg] = []
+        merged: dict[int, list] = {}  # dst -> [[gid, term, commit], ...]
         with self._lock:
-            for g in self.groups.values():
+            for gid, g in self.groups.items():
                 term0, vote0 = g.core.term, g.core.voted_for
                 last0, commit0 = g.core.last_index, g.core.commit
                 g.core.tick()
+                for p in g.core.pending_hb:
+                    merged.setdefault(p, []).append([gid, g.core.term, g.core.commit])
+                g.core.pending_hb.clear()
                 out += self._flush(g, term0, vote0, last0, commit0)
+        for dst, slices in merged.items():
+            out.append(Msg(type="group_hb", group=0, src=self.node_id, dst=dst,
+                           term=0, hb=slices))
         if out:
             self.net.send(out)
 
@@ -247,6 +258,12 @@ class MultiRaft:
         out: list[Msg] = []
         with self._lock:
             for m in msgs:
+                if m.type == "group_hb":
+                    out += self._on_group_hb(m)
+                    continue
+                if m.type == "group_hb_resp":
+                    out += self._on_group_hb_resp(m)
+                    continue
                 g = self.groups.get(m.group)
                 if g is None:
                     continue
@@ -256,6 +273,39 @@ class MultiRaft:
                 out += self._flush(g, term0, vote0, last0, commit0)
         if out:
             self.net.send(out)
+
+    def _on_group_hb(self, m: Msg) -> list[Msg]:
+        """Fan a merged heartbeat into each group; stale sender terms ride
+        back in ONE merged response."""
+        out: list[Msg] = []
+        stale: list = []
+        for gid, term, commit in m.hb:
+            g = self.groups.get(gid)
+            if g is None:
+                continue
+            term0, vote0 = g.core.term, g.core.voted_for
+            last0, commit0 = g.core.last_index, g.core.commit
+            ok = g.core.step_group_hb(m.src, term, commit)
+            out += self._flush(g, term0, vote0, last0, commit0)
+            if not ok:
+                stale.append([gid, g.core.term])
+        if stale:
+            out.append(Msg(type="group_hb_resp", group=0, src=self.node_id,
+                           dst=m.src, term=0, hb=stale))
+        return out
+
+    def _on_group_hb_resp(self, m: Msg) -> list[Msg]:
+        """A peer saw a higher term for these groups: step down there."""
+        out: list[Msg] = []
+        for gid, term in m.hb:
+            g = self.groups.get(gid)
+            if g is None or term <= g.core.term:
+                continue
+            term0, vote0 = g.core.term, g.core.voted_for
+            last0, commit0 = g.core.last_index, g.core.commit
+            g.core._become_follower(term, None)
+            out += self._flush(g, term0, vote0, last0, commit0)
+        return out
 
     def _flush(self, g: _Group, term0: int, vote0, last0: int, commit0: int) -> list[Msg]:
         core = g.core

@@ -39,7 +39,7 @@ DEFAULT_SECRET = b"chubaofs-tpu-raft"
 _MSG_FIELDS = (
     "type", "group", "src", "dst", "term", "last_log_index", "last_log_term",
     "granted", "prev_index", "prev_term", "commit", "success", "match_index",
-    "snap_index", "snap_term", "snap_data",
+    "snap_index", "snap_term", "snap_data", "hb",
 )
 
 

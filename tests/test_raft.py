@@ -192,3 +192,87 @@ def test_leader_change_callback():
     assert run_until(net, lambda: leader_id(nodes) is not None)
     lead = leader_id(nodes)
     assert sms[lead].leader_changes[-1] == lead
+
+
+# -- merged cross-group heartbeats (tiglabs raft README:18) ---------------------
+
+
+def test_merged_heartbeats_one_message_per_peer_pair():
+    """1,000 partitions != 1,000 heartbeat streams: a quiescent tick emits at
+    most ONE group_hb per (src, dst) pair carrying every group's slice, and
+    zero per-group appends."""
+    net = InProcNet()
+    nodes = {i: MultiRaft(i, net) for i in (1, 2, 3)}
+    NG = 12
+    gids = list(range(100, 100 + NG))
+    for gid in gids:
+        for n in nodes.values():
+            n.create_group(gid, [1, 2, 3], KvSM())
+    assert run_until(net, lambda: all(
+        any(n.is_leader(g) for n in nodes.values()) for g in gids))
+    for _ in range(6):  # drain no-op barrier replication; reach quiescence
+        for n in nodes.values():
+            n.tick()
+
+    sent = []
+    orig = net.send
+
+    def spy(msgs):
+        sent.extend(msgs)
+        orig(msgs)
+
+    net.send = spy
+    # HEARTBEAT_TICKS=2: two ticks guarantee every leader beats exactly once
+    # (groups' elapsed phases differ, so beats spread over the two ticks)
+    total_slices = 0
+    for _ in range(2):
+        sent.clear()
+        for n in nodes.values():
+            n.tick()
+        appends = [m for m in sent if m.type == "append"]
+        assert not appends, f"quiescent tick sent per-group appends: {appends[:3]}"
+        hbs = [m for m in sent if m.type == "group_hb"]
+        pairs = [(m.src, m.dst) for m in hbs]
+        assert len(pairs) == len(set(pairs)), \
+            "more than one heartbeat message per peer pair in one tick"
+        total_slices += sum(len(m.hb) for m in hbs)
+    net.send = orig
+    # every group rode some merged message, each to both followers
+    assert total_slices == NG * 2
+
+
+def test_merged_heartbeats_suppress_elections_and_propagate_commit():
+    net = InProcNet()
+    nodes = {i: MultiRaft(i, net) for i in (1, 2, 3)}
+    sms = {i: KvSM() for i in nodes}
+    for i, n in nodes.items():
+        n.create_group(5, [1, 2, 3], sms[i])
+    assert run_until(net, lambda: any(n.is_leader(5) for n in nodes.values()))
+    lead = next(n for n in nodes.values() if n.is_leader(5))
+    term0 = lead.groups[5].core.term
+    fut = lead.propose(5, ("set", "k", 1))
+    assert run_until(net, lambda: fut.done())
+    # long quiescent stretch: merged heartbeats keep followers from campaigning
+    for _ in range(60):
+        for n in nodes.values():
+            n.tick()
+    assert lead.is_leader(5)
+    assert lead.groups[5].core.term == term0
+    # commit propagated to every replica (rides the merged beat)
+    assert all(sm.kv.get("k") == 1 for sm in sms.values())
+
+
+def test_merged_heartbeat_dethrones_stale_leader():
+    net = InProcNet()
+    nodes = {i: MultiRaft(i, net) for i in (1, 2, 3)}
+    for i, n in nodes.items():
+        n.create_group(7, [1, 2, 3], KvSM())
+    assert run_until(net, lambda: any(n.is_leader(7) for n in nodes.values()))
+    old = next(n for n in nodes.values() if n.is_leader(7))
+    net.isolate(old.node_id)
+    others = [n for n in nodes.values() if n is not old]
+    assert run_until(net, lambda: any(n.is_leader(7) for n in others))
+    net.heal()
+    # the deposed leader's merged beat draws a stale response (or the new
+    # leader's beat carries the higher term) — either way it steps down
+    assert run_until(net, lambda: not old.is_leader(7))

@@ -351,3 +351,68 @@ def test_versioned_get_supports_range(s3env):
                           headers={"range": "bytes=2-5"})
     assert status == 206 and got == b"2345"
     assert hh["Content-Range"] == "bytes 2-5/10"
+
+
+def test_delete_current_version_promotes_previous(s3env):
+    """Deleting the current version by id surfaces the previous version as
+    latest (the S3 'undo an overwrite' flow)."""
+    s3, _ = s3env
+    req(s3, "PUT", "/verbkt8")
+    en = b"<VersioningConfiguration><Status>Enabled</Status></VersioningConfiguration>"
+    req(s3, "PUT", "/verbkt8", body=en, raw_query="versioning")
+    _, h1, _ = req(s3, "PUT", "/verbkt8/k", body=b"first")
+    v1 = h1["x-amz-version-id"]
+    _, h2, _ = req(s3, "PUT", "/verbkt8/k", body=b"second")
+    v2 = h2["x-amz-version-id"]
+    assert req(s3, "DELETE", "/verbkt8/k", raw_query=f"versionId={v2}")[0] == 204
+    status, hh, got = req(s3, "GET", "/verbkt8/k")
+    assert status == 200 and got == b"first"
+    status, _, got = req(s3, "GET", "/verbkt8/k", raw_query=f"versionId={v1}")
+    assert status == 200 and got == b"first"
+
+
+def test_null_version_id_is_not_a_real_version(s3env):
+    s3, _ = s3env
+    req(s3, "PUT", "/verbkt9")
+    en = b"<VersioningConfiguration><Status>Enabled</Status></VersioningConfiguration>"
+    req(s3, "PUT", "/verbkt9", body=en, raw_query="versioning")
+    req(s3, "PUT", "/verbkt9/k", body=b"real-version")  # current has a REAL id
+    assert req(s3, "GET", "/verbkt9/k", raw_query="versionId=null")[0] == 404
+
+
+def test_batch_delete_respects_suspended_versioning(s3env):
+    s3, _ = s3env
+    req(s3, "PUT", "/verbkt10")
+    en = b"<VersioningConfiguration><Status>Enabled</Status></VersioningConfiguration>"
+    su = b"<VersioningConfiguration><Status>Suspended</Status></VersioningConfiguration>"
+    req(s3, "PUT", "/verbkt10", body=en, raw_query="versioning")
+    _, h, _ = req(s3, "PUT", "/verbkt10/k", body=b"keep-me")
+    v1 = h["x-amz-version-id"]
+    req(s3, "PUT", "/verbkt10", body=su, raw_query="versioning")
+    dele = b"<Delete><Object><Key>k</Key></Object></Delete>"
+    req(s3, "POST", "/verbkt10", body=dele, raw_query="delete")
+    # the real version survived the batch delete under Suspended
+    assert req(s3, "GET", "/verbkt10/k",
+               raw_query=f"versionId={v1}")[2] == b"keep-me"
+
+
+def test_presigned_v2_subresource_bound(s3env):
+    """A V2 presigned URL for the plain object cannot be retargeted at a
+    subresource (the canonical resource covers them)."""
+    s3, _ = s3env
+    q = presign_v2("GET", "/psbkt/obj", AK, SK, int(time.time()) + 300)
+    assert raw_req(s3, "GET", "/psbkt/obj?" + q)[0] == 200
+    assert raw_req(s3, "GET", "/psbkt/obj?acl&" + q)[0] == 403
+    # signing the subresource explicitly works
+    q = presign_v2("GET", "/psbkt/obj", AK, SK, int(time.time()) + 300,
+                   subresource_query="acl")
+    assert raw_req(s3, "GET", "/psbkt/obj?" + q)[0] == 200
+
+
+def test_malformed_presigned_params_403_not_500(s3env):
+    s3, _ = s3env
+    bad = ("X-Amz-Algorithm=AWS4-HMAC-SHA256&X-Amz-Credential=" + AK +
+           "&X-Amz-Date=garbage&X-Amz-Expires=60&X-Amz-SignedHeaders=host"
+           "&X-Amz-Signature=deadbeef")
+    status, body = raw_req(s3, "GET", "/psbkt/obj?" + bad)
+    assert status == 403
