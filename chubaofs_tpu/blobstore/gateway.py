@@ -69,8 +69,15 @@ def build_router(access: Access) -> Router:
 
 
 class AccessGateway:
-    def __init__(self, access: Access, host: str = "127.0.0.1", port: int = 0):
-        self.server = RPCServer(build_router(access), host=host, port=port)
+    """Standalone access server. `router_hook(router)` lets the caller mount
+    extra routes (the blobstore daemon adds its admin surface this way)."""
+
+    def __init__(self, access: Access, host: str = "127.0.0.1", port: int = 0,
+                 router_hook=None):
+        router = build_router(access)
+        if router_hook is not None:
+            router_hook(router)
+        self.server = RPCServer(router, host=host, port=port)
         self.server.start()
         self.addr = self.server.addr
 

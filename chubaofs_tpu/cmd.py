@@ -529,21 +529,40 @@ class BlobstoreDaemon(_Daemon):
     def __init__(self, cfg: dict):
         super().__init__()
         from chubaofs_tpu.blobstore.cluster import MiniCluster
+        from chubaofs_tpu.blobstore.cmd import ModuleRunner, add_admin_routes
         from chubaofs_tpu.blobstore.gateway import AccessGateway
 
-        self.cluster = MiniCluster(
-            cfg["root"], n_nodes=int(cfg.get("nodes", 6)),
-            disks_per_node=int(cfg.get("disksPerNode", 2)),
-            azs=int(cfg.get("azs", 1)))
-        host, port = _addr_split(cfg.get("listen", "127.0.0.1:0"))
-        self.gateway = AccessGateway(self.cluster.access, host=host, port=port)
-        self.addr = self.gateway.addr
-        self._every(1.0, self.cluster.run_background_once, "blobstore-bg")
+        runner = ModuleRunner(cfg=dict(cfg))
+
+        def up_cluster(c, handles):
+            return MiniCluster(c["root"], n_nodes=int(c.get("nodes", 6)),
+                               disks_per_node=int(c.get("disksPerNode", 2)),
+                               azs=int(c.get("azs", 1)))
+
+        def up_gateway(c, handles):
+            host, port = _addr_split(c.get("listen", "127.0.0.1:0"))
+            gw = AccessGateway(
+                handles["cluster"].access, host=host, port=port,
+                router_hook=lambda r: add_admin_routes(r, handles["cluster"],
+                                                       runner))
+            c["listen"] = gw.addr  # graceful reloads rebind the SAME address
+            return gw
+
+        runner.register("cluster", up_cluster, lambda h: h.close())
+        runner.register("gateway", up_gateway, lambda h: h.stop())
+        runner.start()
+        self.runner = runner
+        self.addr = runner.handles["gateway"].addr
+        self._every(1.0, self._bg_tick, "blobstore-bg")
+
+    def _bg_tick(self):
+        # under the runner lock, so a tick can never race a concurrent
+        # reload's teardown of the cluster it is sweeping
+        self.runner.call_with("cluster", lambda c: c.run_background_once())
 
     def stop(self):
         super().stop()
-        self.gateway.stop()
-        self.cluster.close()
+        self.runner.stop()
 
 
 class _MasterUserStore:

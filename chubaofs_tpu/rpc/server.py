@@ -77,6 +77,8 @@ class RPCServer:
                 200, {"Content-Type": "text/plain"}, registry.render().encode()))
 
         outer = self
+        self._inflight = 0
+        self._drain = threading.Condition()
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
@@ -85,6 +87,16 @@ class RPCServer:
                 pass
 
             def _serve(self):
+                with outer._drain:
+                    outer._inflight += 1
+                try:
+                    self._serve_inner()
+                finally:
+                    with outer._drain:
+                        outer._inflight -= 1
+                        outer._drain.notify_all()
+
+            def _serve_inner(self):
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length) if length else b""
                 req = parse_request(self.command, self.path,
@@ -118,8 +130,18 @@ class RPCServer:
         self._thread.start()
         return self
 
-    def stop(self):
+    def stop(self, drain_timeout: float = 10.0):
+        """Stop accepting, then DRAIN: wait for in-flight handlers to finish
+        (bounded) before returning — the graceful-restart contract the
+        blobstore module reload depends on (blobstore/cmd/cmd.go analog)."""
         self.httpd.shutdown()
         self.httpd.server_close()
+        deadline = time.monotonic() + drain_timeout
+        with self._drain:
+            while self._inflight > 0:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break  # wedged handler: don't hold the restart hostage
+                self._drain.wait(remaining)
         if self._thread:
             self._thread.join(timeout=5)
