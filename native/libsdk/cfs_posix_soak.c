@@ -1,0 +1,165 @@
+/* cfs_posix_soak — LTP-style POSIX metadata/IO soak over libcfs.so.
+ *
+ * Reference analog: the docker suite's `runltp -f fs` battery on a real
+ * mount (docker/script/run_test.sh:213-222). This driver is an external,
+ * Python-free process hammering the C ABI against a live cluster:
+ *
+ *   per thread, in its own directory, ITER rounds of:
+ *     create -> pwrite pattern -> read-back verify -> truncate shrink ->
+ *     re-extend -> rename -> hard link -> unlink one name -> read via the
+ *     other -> readdir checks -> rmdir (ENOTEMPTY first, then clean)
+ *   then a shared-directory rename storm across all threads.
+ *
+ * usage: cfs_posix_soak '<config json>' [threads] [iters]
+ * exit 0 and "posix soak ok" on success; nonzero + first failure otherwise.
+ */
+#include <pthread.h>
+#include <stdatomic.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "libcfs.h"
+
+#define O_WRONLY 1
+#define O_RDWR 2
+#define O_CREAT 0100
+
+static int64_t g_cid;
+static int g_iters = 3;
+static atomic_int g_failed = 0;
+static pthread_mutex_t g_mu = PTHREAD_MUTEX_INITIALIZER;
+
+#define FAIL(...)                            \
+  do {                                       \
+    pthread_mutex_lock(&g_mu);               \
+    if (!atomic_load(&g_failed)) {           \
+      fprintf(stderr, "FAIL: " __VA_ARGS__); \
+      fprintf(stderr, " (err=%s)\n", cfs_last_error()); \
+    }                                        \
+    atomic_store(&g_failed, 1);              \
+    pthread_mutex_unlock(&g_mu);             \
+    return NULL;                             \
+  } while (0)
+
+static void fill(char* buf, int n, unsigned seed) {
+  for (int i = 0; i < n; i++) buf[i] = (char)((seed + i * 31) & 0xff);
+}
+
+static void* worker(void* arg) {
+  long t = (long)arg;
+  char dir[64], fa[96], fb[96], fc[96], shared[96];
+  snprintf(dir, sizeof dir, "/soak/t%ld", t);
+  if (cfs_mkdirs(g_cid, dir, 0755) != 0) FAIL("mkdirs %s", dir);
+
+  char want[8192], got[8192];
+  for (int it = 0; it < g_iters && !atomic_load(&g_failed); it++) {
+    snprintf(fa, sizeof fa, "%s/a%d", dir, it);
+    snprintf(fb, sizeof fb, "%s/b%d", dir, it);
+    snprintf(fc, sizeof fc, "%s/c%d", dir, it);
+
+    /* create + two pwrites (one overlapping overwrite) + verify */
+    int fd = cfs_open(g_cid, fa, O_CREAT | O_RDWR, 0644);
+    if (fd < 0) FAIL("open %s", fa);
+    fill(want, 4096, (unsigned)(t * 100 + it));
+    if (cfs_write(g_cid, fd, want, 4096, 0) != 4096) FAIL("write %s", fa);
+    fill(want + 1024, 2048, (unsigned)(t * 7 + it));
+    if (cfs_write(g_cid, fd, want + 1024, 2048, 1024) != 2048)
+      FAIL("overwrite %s", fa);
+    if (cfs_flush(g_cid, fd) != 0) FAIL("flush %s", fa);
+    if (cfs_read(g_cid, fd, got, 4096, 0) != 4096) FAIL("read %s", fa);
+    if (memcmp(want, got, 4096) != 0) FAIL("content mismatch %s", fa);
+
+    /* truncate shrink, stat size, re-extend by writing past EOF */
+    if (cfs_truncate(g_cid, fa, 1000) != 0) FAIL("truncate %s", fa);
+    cfs_stat_t st;
+    if (cfs_getattr(g_cid, fa, &st) != 0 || st.size != 1000)
+      FAIL("size after truncate %s: %llu", fa, (unsigned long long)st.size);
+    if (cfs_write(g_cid, fd, want, 512, 1000) != 512) FAIL("extend %s", fa);
+    if (cfs_flush(g_cid, fd) != 0) FAIL("flush2 %s", fa);
+    if (cfs_getattr(g_cid, fa, &st) != 0 || st.size != 1512)
+      FAIL("size after extend %s: %llu", fa, (unsigned long long)st.size);
+    if (cfs_close(g_cid, fd) != 0) FAIL("close %s", fa);
+
+    /* rename: old name gone, new name serves the bytes */
+    if (cfs_rename(g_cid, fa, fb) != 0) FAIL("rename %s", fa);
+    if (cfs_getattr(g_cid, fa, &st) == 0) FAIL("stale name %s", fa);
+    if (cfs_getattr(g_cid, fb, &st) != 0 || st.size != 1512)
+      FAIL("renamed stat %s", fb);
+
+    /* hard link: unlink one name, the other still serves the inode */
+    if (cfs_link(g_cid, fb, fc) != 0) FAIL("link %s -> %s", fb, fc);
+    if (cfs_unlink(g_cid, fb) != 0) FAIL("unlink %s", fb);
+    fd = cfs_open(g_cid, fc, O_RDWR, 0644);
+    if (fd < 0) FAIL("open via link %s", fc);
+    if (cfs_read(g_cid, fd, got, 1000, 0) != 1000) FAIL("read via link %s", fc);
+    if (memcmp(want, got, 1000) != 0) FAIL("link content %s", fc);
+    cfs_close(g_cid, fd);
+
+    /* readdir sees exactly the surviving name for this round */
+    char names[4096];
+    if (cfs_readdir(g_cid, dir, names, sizeof names) < 0)
+      FAIL("readdir %s", dir);
+    char base[32];
+    snprintf(base, sizeof base, "c%d", it);
+    if (strstr(names, base) == NULL) FAIL("readdir missing %s in %s", base, dir);
+
+    /* rmdir of a non-empty dir must refuse */
+    if (cfs_rmdir(g_cid, dir) == 0) FAIL("rmdir of non-empty %s succeeded", dir);
+  }
+
+  /* shared-directory rename storm: dentry churn across threads */
+  for (int it = 0; it < g_iters && !atomic_load(&g_failed); it++) {
+    snprintf(fc, sizeof fc, "%s/c%d", dir, it);
+    snprintf(shared, sizeof shared, "/soak/shared/t%ld_c%d", t, it);
+    if (cfs_rename(g_cid, fc, shared) != 0) FAIL("rename into shared %s", shared);
+  }
+  return NULL;
+}
+
+int main(int argc, char** argv) {
+  if (argc < 2) {
+    fprintf(stderr, "usage: %s '<config json>' [threads] [iters]\n", argv[0]);
+    return 2;
+  }
+  int nthreads = argc > 2 ? atoi(argv[2]) : 4;
+  g_iters = argc > 3 ? atoi(argv[3]) : 3;
+
+  g_cid = cfs_new_client(argv[1]);
+  if (g_cid < 0) {
+    fprintf(stderr, "new_client failed: %s\n", cfs_last_error());
+    return 1;
+  }
+  if (cfs_mkdirs(g_cid, "/soak/shared", 0755) != 0) {
+    fprintf(stderr, "mkdirs /soak/shared: %s\n", cfs_last_error());
+    return 1;
+  }
+
+  pthread_t th[64];
+  if (nthreads > 64) nthreads = 64;
+  for (long t = 0; t < nthreads; t++) pthread_create(&th[t], NULL, worker, (void*)t);
+  for (int t = 0; t < nthreads; t++) pthread_join(th[t], NULL);
+
+  if (!atomic_load(&g_failed)) {
+    /* every thread's renames landed in the shared dir: stat each expected
+     * name exactly (readdir output would truncate at large thread*iter) */
+    for (int t = 0; t < nthreads && !atomic_load(&g_failed); t++) {
+      for (int it = 0; it < g_iters; it++) {
+        char shared[96];
+        cfs_stat_t st;
+        snprintf(shared, sizeof shared, "/soak/shared/t%d_c%d", t, it);
+        if (cfs_getattr(g_cid, shared, &st) != 0) {
+          fprintf(stderr, "FAIL: %s missing after rename storm (err=%s)\n",
+                  shared, cfs_last_error());
+          atomic_store(&g_failed, 1);
+          break;
+        }
+      }
+    }
+  }
+
+  cfs_close_client(g_cid);
+  if (atomic_load(&g_failed)) return 1;
+  printf("posix soak ok: %d threads x %d iters\n", nthreads, g_iters);
+  return 0;
+}

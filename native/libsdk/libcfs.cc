@@ -290,6 +290,14 @@ int cfs_rename(int64_t cid, const char* from, const char* to) {
   return 0;
 }
 
+int cfs_link(int64_t cid, const char* existing, const char* newpath) {
+  Gil gil;
+  PyObject* out = call(cid, "link", Py_BuildValue("(ss)", existing, newpath));
+  if (!out) return capture_error();
+  Py_DECREF(out);
+  return 0;
+}
+
 int cfs_truncate(int64_t cid, const char* path, int64_t size) {
   Gil gil;
   PyObject* out = call(cid, "truncate", Py_BuildValue("(sL)", path,

@@ -54,6 +54,7 @@ int cfs_mkdirs(int64_t cid, const char* path, int mode);
 int cfs_rmdir(int64_t cid, const char* path);
 int cfs_unlink(int64_t cid, const char* path);
 int cfs_rename(int64_t cid, const char* from, const char* to);
+int cfs_link(int64_t cid, const char* existing, const char* newpath);
 int cfs_truncate(int64_t cid, const char* path, int64_t size);
 /* entries newline-joined into buf; returns bytes written or -errno */
 int cfs_readdir(int64_t cid, const char* path, char* buf, int buflen);

@@ -1,7 +1,14 @@
 """libcfs C ABI: build the native library, spin a real daemon cluster in
-subprocesses, and run the pure-C smoke driver against it (libsdk/ analog —
-the reference exercises libcfs.so from C/Java the same way)."""
+subprocesses, and run external Python-free drivers against it (libsdk/
+analog). Two batteries:
 
+  * cfs_smoke — basic open/write/read lifecycle (the reference's libsdk demo)
+  * cfs_posix_soak — LTP-style metadata/IO soak (rename/link/truncate/readdir
+    under pthread concurrency), the `runltp -f fs` analog of
+    docker/script/run_test.sh:213-222.
+"""
+
+import contextlib
 import json
 import os
 import shutil
@@ -15,9 +22,11 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LIBSDK = os.path.join(REPO, "native", "libsdk")
 
 
-def _build():
+def _build(target: str):
+    if shutil.which("make") is None:
+        pytest.skip("no make")
     try:
-        subprocess.run(["make", "-C", LIBSDK, "build/cfs_smoke"],
+        subprocess.run(["make", "-C", LIBSDK, f"build/{target}"],
                        check=True, capture_output=True, timeout=180)
     except (OSError, subprocess.SubprocessError) as e:
         pytest.skip(f"libcfs build unavailable: {e}")
@@ -33,12 +42,9 @@ def _spawn(cfg: dict, tmp, name: str, env):
         stderr=subprocess.STDOUT, env=env)
 
 
-@pytest.mark.slow
-def test_c_smoke_against_subprocess_cluster(tmp_path):
-    if shutil.which("make") is None:
-        pytest.skip("no make")
-    _build()
-
+@contextlib.contextmanager
+def _cluster(tmp_path, vol_name: str):
+    """A real 1-master/3-metanode/3-datanode subprocess cluster + volume."""
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env.setdefault("JAX_PLATFORMS", "cpu")
@@ -55,18 +61,20 @@ def test_c_smoke_against_subprocess_cluster(tmp_path):
             "role": "master", "id": 1,
             "raftPeers": {"1": "127.0.0.1:0"},
             "listen": master_addr, "walDir": str(tmp_path / "m1"),
+            "jaxPlatform": "cpu",
         }, tmp_path, "m1", env))
         time.sleep(0.8)
         for i in (2, 3, 4):
             procs.append(_spawn({
                 "role": "metanode", "id": i, "masterAddrs": [master_addr],
-                "walDir": str(tmp_path / f"mn{i}"),
+                "walDir": str(tmp_path / f"mn{i}"), "jaxPlatform": "cpu",
             }, tmp_path, f"mn{i}", env))
         for j in (1, 2, 3):
             procs.append(_spawn({
                 "role": "datanode", "id": 100 + j, "masterAddrs": [master_addr],
                 "disks": [str(tmp_path / f"dn{j}" / "d0")],
                 "walDir": str(tmp_path / f"dn{j}" / "wal"),
+                "jaxPlatform": "cpu",
             }, tmp_path, f"dn{j}", env))
 
         from chubaofs_tpu.master.api_service import MasterClient
@@ -82,16 +90,11 @@ def test_c_smoke_against_subprocess_cluster(tmp_path):
             time.sleep(0.3)
         else:
             raise AssertionError("cluster did not come up")
-        mc.create_volume("libvol", cold=False)
+        mc.create_volume(vol_name, cold=False)
 
-        smoke_env = dict(env)
-        smoke_env["CFS_PYTHONPATH"] = REPO
-        cfg = json.dumps({"masterAddr": master_addr, "volName": "libvol"})
-        out = subprocess.run(
-            [os.path.join(LIBSDK, "build", "cfs_smoke"), cfg],
-            capture_output=True, timeout=120, env=smoke_env, text=True)
-        assert out.returncode == 0, f"stdout={out.stdout} stderr={out.stderr}"
-        assert "libcfs smoke ok" in out.stdout
+        driver_env = dict(env)
+        driver_env["CFS_PYTHONPATH"] = REPO
+        yield master_addr, driver_env
     finally:
         for p in procs:
             p.terminate()
@@ -100,3 +103,30 @@ def test_c_smoke_against_subprocess_cluster(tmp_path):
                 p.wait(timeout=5)
             except subprocess.TimeoutExpired:
                 p.kill()
+
+
+@pytest.mark.slow
+def test_c_smoke_against_subprocess_cluster(tmp_path):
+    _build("cfs_smoke")
+    with _cluster(tmp_path, "libvol") as (master_addr, env):
+        cfg = json.dumps({"masterAddr": master_addr, "volName": "libvol"})
+        out = subprocess.run(
+            [os.path.join(LIBSDK, "build", "cfs_smoke"), cfg],
+            capture_output=True, timeout=120, env=env, text=True)
+        assert out.returncode == 0, f"stdout={out.stdout} stderr={out.stderr}"
+        assert "libcfs smoke ok" in out.stdout
+
+
+@pytest.mark.slow
+def test_posix_soak_against_subprocess_cluster(tmp_path):
+    """The external POSIX proof: a Python-free pthread process soaking
+    create/pwrite/truncate/rename/link/unlink/readdir/rmdir against a real
+    3-node cluster through libcfs.so (LTP `runltp -f fs` analog)."""
+    _build("cfs_posix_soak")
+    with _cluster(tmp_path, "soakvol") as (master_addr, env):
+        cfg = json.dumps({"masterAddr": master_addr, "volName": "soakvol"})
+        out = subprocess.run(
+            [os.path.join(LIBSDK, "build", "cfs_posix_soak"), cfg, "4", "3"],
+            capture_output=True, timeout=300, env=env, text=True)
+        assert out.returncode == 0, f"stdout={out.stdout} stderr={out.stderr}"
+        assert "posix soak ok: 4 threads x 3 iters" in out.stdout
