@@ -11,6 +11,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 
 from chubaofs_tpu.blobstore.clustermgr import ClusterMgr, VolumeInfo
 
@@ -60,12 +61,20 @@ class TopicQueue:
 
 
 class Proxy:
-    """Per-IDC stateless proxy: cached volume grants + message production."""
+    """Per-IDC stateless proxy: cached volume grants + message production.
 
-    def __init__(self, cm: ClusterMgr, data_dir: str | None = None):
+    Grants EXPIRE (alloc_ttl): like the reference allocator's renewal loop
+    (proxy/allocator/volumemgr.go:348,512), a cached volume is re-validated
+    against clustermgr after the TTL so a long-running proxy never keeps
+    serving a volume that was retired, locked, or filled behind its back."""
+
+    def __init__(self, cm: ClusterMgr, data_dir: str | None = None,
+                 alloc_ttl: float = 30.0):
         self.cm = cm
+        self.alloc_ttl = alloc_ttl
         self._lock = threading.Lock()
-        self._cached: dict[int, VolumeInfo] = {}  # code_mode -> active volume
+        # code_mode -> (volume grant, monotonic expiry)
+        self._cached: dict[int, tuple[VolumeInfo, float]] = {}
         d = data_dir
         self.topics = {
             TOPIC_SHARD_REPAIR: TopicQueue(os.path.join(d, "repair.jsonl") if d else None),
@@ -75,11 +84,12 @@ class Proxy:
     # -- allocator (volumemgr.go:348 Alloc analog) ---------------------------
 
     def alloc_volume(self, code_mode: int) -> VolumeInfo:
+        now = time.monotonic()
         with self._lock:
-            vol = self._cached.get(code_mode)
-            if vol is None or vol.status != "active":
-                vol = self.cm.alloc_volume(code_mode)
-                self._cached[code_mode] = vol
+            vol, expires = self._cached.get(code_mode, (None, 0.0))
+            if vol is None or vol.status != "active" or now >= expires:
+                vol = self.cm.alloc_volume(code_mode)  # renewal from clustermgr
+                self._cached[code_mode] = (vol, now + self.alloc_ttl)
             return vol
 
     def alloc_bids(self, count: int) -> tuple[int, int]:

@@ -53,8 +53,9 @@ def table(rows: list[dict], columns: list[str], out) -> None:
 
 
 class CLI:
-    def __init__(self, addrs: list[str], out=None, as_json: bool = False):
-        self.mc = MasterClient(addrs)
+    def __init__(self, addrs: list[str], out=None, as_json: bool = False,
+                 ticket: str | None = None):
+        self.mc = MasterClient(addrs, admin_ticket=ticket)
         self.out = out or sys.stdout
         self.as_json = as_json
 
@@ -196,6 +197,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="master address host:port (repeatable); defaults to "
                         "the configured masters")
     p.add_argument("--json", action="store_true", help="machine output")
+    p.add_argument("--ticket", default=None,
+                   help="authnode master:admin capability ticket (b64); "
+                        "defaults to the configured adminTicket")
     sub = p.add_subparsers(dest="noun", required=True)
 
     cluster = sub.add_parser("cluster").add_subparsers(dest="verb", required=True)
@@ -288,14 +292,16 @@ def main(argv: list[str] | None = None, out=None) -> int:
         print(json.dumps(load_config(), indent=2), file=out)
         return 0
 
-    addrs = args.addr or load_config().get("masterAddrs")
+    cfg = load_config()
+    addrs = args.addr or cfg.get("masterAddrs")
+    ticket = args.ticket or cfg.get("adminTicket")
     if not addrs:
         print("no master address: pass --addr or run "
               "`cfs-cli config set --addr host:port`", file=sys.stderr)
         return 2
     from chubaofs_tpu.rpc.errors import HTTPError
 
-    cli = CLI(addrs, out=out, as_json=args.json)
+    cli = CLI(addrs, out=out, as_json=args.json, ticket=ticket)
     try:
         getattr(cli, args.fn)(args)
     except (MasterError, HTTPError, OSError) as e:

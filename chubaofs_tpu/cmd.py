@@ -137,9 +137,15 @@ class MasterDaemon(_Daemon):
         self.master.raft_config_hook = self._raft_config_hook
         self.master.remove_partition_hook = self._remove_partition_hook
         svc_secret = cfg.get("serviceSecret")
+        ticket_key = cfg.get("adminTicketKey")  # b64 authnode service key
+        if ticket_key:
+            import base64
+
+            ticket_key = base64.b64decode(ticket_key)
         self.api = MasterAPI(self.master,
                              leader_addr_of=lambda nid: self.peer_apis.get(nid, ""),
-                             service_secret=svc_secret.encode() if svc_secret else None)
+                             service_secret=svc_secret.encode() if svc_secret else None,
+                             admin_ticket_key=ticket_key or None)
         host, port = _addr_split(cfg.get("listen", "127.0.0.1:0"))
         self.server = RPCServer(self.api.router, host=host, port=port).start()
         self.addr = self.server.addr
@@ -339,7 +345,8 @@ class MetaNodeDaemon(_Daemon):
         host, port = _addr_split(cfg.get("listen", "127.0.0.1:0"))
         self.service = MetaService(self.metanode, host=host, port=port)
         self.addr = _advertise(self.service.addr, cfg)
-        self.mc = MasterClient(cfg["masterAddrs"])
+        self.mc = MasterClient(cfg["masterAddrs"],
+                               admin_ticket=cfg.get("adminTicket"))
         self.ticker = TickLoop([self.raft], interval=cfg.get("tickInterval", 0.02))
         self.ticker.start()
         try:
@@ -487,7 +494,8 @@ class DataNodeDaemon(_Daemon):
         self.zone = cfg.get("zone", "")
         self.datanode.start()
         self.addr = _advertise(self.datanode.addr, cfg)
-        self.mc = MasterClient(cfg["masterAddrs"])
+        self.mc = MasterClient(cfg["masterAddrs"],
+                               admin_ticket=cfg.get("adminTicket"))
         self.ticker = TickLoop([self.raft], interval=cfg.get("tickInterval", 0.02))
         self.ticker.start()
         try:

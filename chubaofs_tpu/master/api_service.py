@@ -24,6 +24,7 @@ CODE_OK = 0
 CODE_ERR = 1
 CODE_NOT_LEADER = 2
 CODE_BUSY = 3  # QoS limit hit; clients back off and retry (master/limiter.go)
+CODE_DENIED = 4  # missing/invalid capability ticket (authnode-gated admin op)
 
 
 def envelope(data=None, code: int = CODE_OK, msg: str = "success") -> dict:
@@ -34,19 +35,25 @@ class MasterAPI:
     """HTTP service bound to one master replica."""
 
     def __init__(self, master: Master, leader_addr_of=None,
-                 service_secret: bytes | None = None, qos=None):
+                 service_secret: bytes | None = None, qos=None,
+                 admin_ticket_key: bytes | None = None):
         """leader_addr_of: node_id -> admin-API address, for leader redirects.
         service_secret gates the credential-bearing /user/akInfo endpoint
         (objectnode signs with it); without one, akInfo only answers loopback
         clients — S3 secrets must never be harvestable off the open admin API
         (round-1 advisory). qos: a utils.ratelimit.KeyedLimiter with per-route
-        op limits (master/limiter.go analog); None = unlimited."""
+        op limits (master/limiter.go analog); None = unlimited.
+        admin_ticket_key: the master's authnode SERVICE key — when set,
+        mutating admin routes demand an x-cfs-ticket header carrying the
+        master:admin capability (authnode/api_service.go:37 gating); None
+        keeps the shared-secret-only deployment mode."""
         from chubaofs_tpu.utils.ratelimit import KeyedLimiter
 
         self.master = master
         self.leader_addr_of = leader_addr_of or (lambda node_id: "")
         self.service_secret = service_secret
         self.qos = qos if qos is not None else KeyedLimiter()
+        self.admin_ticket_key = admin_ticket_key
         self.router = self._build()
 
     # -- plumbing -------------------------------------------------------------
@@ -56,37 +63,51 @@ class MasterAPI:
         g = r.get
         g("/admin/getCluster", self._w(self.get_cluster, leader=False))
         g("/admin/getIp", self._w(self.get_ip, leader=False))
-        g("/admin/createVol", self._w(self.create_vol))
-        g("/admin/deleteVol", self._w(self.delete_vol))
+        g("/admin/createVol", self._w(self.create_vol, admin=True))
+        g("/admin/deleteVol", self._w(self.delete_vol, admin=True))
         g("/admin/getVol", self._w(self.get_vol, leader=False))
         g("/admin/listVols", self._w(self.list_vols, leader=False))
-        g("/admin/createDataPartition", self._w(self.create_dp))
+        g("/admin/createDataPartition", self._w(self.create_dp, admin=True))
         g("/client/partitions", self._w(self.client_partitions, leader=False))
         g("/client/metaPartitions", self._w(self.client_meta_partitions, leader=False))
         g("/client/vol", self._w(self.get_vol, leader=False))
-        g("/dataNode/add", self._w(self.add_node_data))
-        g("/metaNode/add", self._w(self.add_node_meta))
-        g("/node/heartbeat", self._w(self.node_heartbeat))
-        g("/dataNode/decommission", self._w(self.decommission_data))
-        g("/metaNode/decommission", self._w(self.decommission_meta))
-        g("/user/create", self._w(self.user_create))
-        g("/user/delete", self._w(self.user_delete))
+        # topology mutations are gated like the rest of the admin surface —
+        # registering a bogus node or wiping cursors via heartbeat is at least
+        # as damaging as a decommission (daemons carry cfg adminTicket)
+        g("/dataNode/add", self._w(self.add_node_data, admin=True))
+        g("/metaNode/add", self._w(self.add_node_meta, admin=True))
+        g("/node/heartbeat", self._w(self.node_heartbeat, admin=True))
+        g("/dataNode/decommission", self._w(self.decommission_data, admin=True))
+        g("/metaNode/decommission", self._w(self.decommission_meta, admin=True))
+        g("/user/create", self._w(self.user_create, admin=True))
+        g("/user/delete", self._w(self.user_delete, admin=True))
         g("/user/info", self._w(self.user_info, leader=False))
         g("/user/akInfo", self._w(self.user_ak_info, leader=False))
-        g("/user/updatePolicy", self._w(self.user_update_policy))
+        g("/user/updatePolicy", self._w(self.user_update_policy, admin=True))
         g("/user/list", self._w(self.user_list, leader=False))
         from chubaofs_tpu.master.gapi import GraphQLAPI
 
         r.post("/graphql", GraphQLAPI(self.master).handle)
         return r
 
-    def _w(self, fn, leader: bool = True):
-        """Wrap a handler: QoS gate + leader gate + MasterError → envelope."""
+    def _w(self, fn, leader: bool = True, admin: bool = False):
+        """Wrap a handler: QoS gate + ticket gate + leader gate + MasterError
+        → envelope."""
 
         def handler(req: Request):
             if not self.qos.allow(req.path):
                 return Response.json(
                     envelope(None, CODE_BUSY, "rate limit exceeded"), status=200)
+            if admin and self.admin_ticket_key is not None:
+                from chubaofs_tpu.authnode.server import verify_ticket
+
+                try:
+                    verify_ticket("master", self.admin_ticket_key,
+                                  req.header("x-cfs-ticket"), action="admin")
+                except Exception as e:  # TicketError, malformed b64, ...
+                    return Response.json(
+                        envelope(None, CODE_DENIED,
+                                 f"admin ticket required: {e}"), status=200)
             if leader and not self.master.is_leader:
                 lead = self.master.raft.leader_of(MASTER_GROUP)
                 addr = self.leader_addr_of(lead) if lead is not None else ""
@@ -250,10 +271,15 @@ class MasterClient:
     """sdk/master analog: follows the not-leader hint across replicas."""
 
     def __init__(self, hosts: list[str], retries: int = 4,
-                 auth_secret: bytes | None = None):
+                 auth_secret: bytes | None = None,
+                 admin_ticket: str | None = None):
         self.auth_secret = auth_secret
+        self.admin_ticket = admin_ticket  # authnode master:admin capability
         self.rpc = RPCClient(hosts, retries=retries, auth_secret=auth_secret)
         self.leader_hint: str | None = None
+
+    def _headers(self) -> dict:
+        return {"x-cfs-ticket": self.admin_ticket} if self.admin_ticket else {}
 
     @staticmethod
     def _path(route: str, **params) -> str:
@@ -272,12 +298,12 @@ class MasterClient:
                 rpc = RPCClient([self.leader_hint], retries=1,
                                 auth_secret=self.auth_secret)
                 try:
-                    out = rpc.get(path)
+                    out = rpc.get(path, headers=self._headers())
                 except (HTTPError, OSError):
                     self.leader_hint = None
                     continue
             else:
-                out = self.rpc.get(path)
+                out = self.rpc.get(path, headers=self._headers())
             code = out.get("code")
             if code == CODE_OK:
                 return out.get("data")

@@ -223,3 +223,99 @@ def test_access_qos_bandwidth(tmp_path, rng):
             c.access.put(blob_bytes(rng, 150_000))  # bucket dry
     finally:
         c.close()
+
+
+# -- proxy allocation renewal (proxy/allocator/volumemgr.go:348,512) -----------
+
+
+def test_proxy_alloc_grant_expires(tmp_path):
+    """A cached volume grant is re-validated against clustermgr after its TTL:
+    a long-running proxy can't keep serving a retired volume forever."""
+    from chubaofs_tpu.blobstore.clustermgr import ClusterMgr
+    from chubaofs_tpu.blobstore.proxy import Proxy
+    from chubaofs_tpu.codec.codemode import CodeMode
+
+    import copy
+
+    cm = ClusterMgr()
+    for d in range(10):
+        cm.register_disk(d, node_id=d)
+    proxy = Proxy(cm, alloc_ttl=0.05)
+    mode = int(CodeMode.EC6P3)
+    v1 = proxy.alloc_volume(mode)
+    assert proxy.alloc_volume(mode).vid == v1.vid  # cached
+    # emulate the RPC boundary: the proxy's grant is a SNAPSHOT, not the
+    # live clustermgr object (in-process they alias, which would let the
+    # status check mask the TTL path under test)
+    vol, exp = proxy._cached[mode]
+    proxy._cached[mode] = (copy.deepcopy(vol), exp)
+    cm.set_volume_status(v1.vid, "idle")  # retired behind the proxy's back
+    # before the TTL the stale grant is still served (cache semantics)...
+    assert proxy.alloc_volume(mode).vid == v1.vid
+    time.sleep(0.06)
+    # ...and after it, renewal against clustermgr rotates to a live volume
+    v2 = proxy.alloc_volume(mode)
+    assert v2.vid != v1.vid and v2.status == "active"
+
+
+# -- authnode capability tickets on admin APIs ---------------------------------
+
+
+def test_master_admin_requires_authnode_ticket(tmp_path, master):
+    """With a ticket key configured, mutating admin routes demand the
+    master:admin capability; reads stay open (authnode/api_service.go:37)."""
+    import json
+
+    from chubaofs_tpu.authnode.server import AuthClient, AuthNode, KeystoreSM
+    from chubaofs_tpu.master.api_service import (
+        CODE_DENIED, CODE_OK, MasterAPI)
+    from chubaofs_tpu.raft.server import InProcNet, MultiRaft
+    from chubaofs_tpu.rpc.router import Request
+
+    # a real authnode mints the service key + an operator ticket
+    net = InProcNet()
+    araft = MultiRaft(9, net)
+    asm = KeystoreSM()
+    from chubaofs_tpu.authnode import AUTH_GROUP
+
+    araft.create_group(AUTH_GROUP, [9], asm)
+    assert run_until(net, lambda: araft.is_leader(AUTH_GROUP))
+    an = AuthNode(araft, asm)
+    svc_key = an.create_key("master", "service")
+    op_key = an.create_key("operator", "client", caps=["master:admin"])
+    grant = AuthClient(an, "operator", op_key).get_ticket("master")
+
+    _register_grid(master, "meta", zones=3, per_zone=2, base=100)
+    api = MasterAPI(master, admin_ticket_key=svc_key)
+
+    def call(path, ticket=None):
+        hdrs = {"x-cfs-ticket": ticket} if ticket else {}
+        req = Request(method="GET", path=path.split("?")[0],
+                      query={k: [v] for k, v in
+                             (p.split("=") for p in path.split("?")[1].split("&"))}
+                      if "?" in path else {},
+                      headers=hdrs, body=b"")
+        return json.loads(api.router.dispatch(req).body)
+
+    # no ticket -> denied; read route stays open
+    out = call("/admin/createVol?name=tv&cold=true&dpCount=0")
+    assert out["code"] == CODE_DENIED
+    assert call("/admin/getCluster")["code"] == CODE_OK
+
+    # valid operator ticket -> allowed
+    out = call("/admin/createVol?name=tv&cold=true&dpCount=0",
+               ticket=grant["ticket"])
+    assert out["code"] == CODE_OK, out
+
+    # a ticket without the admin capability -> denied
+    weak_key = an.create_key("peon", "client", caps=["objectnode:read"])
+    weak = AuthClient(an, "peon", weak_key).get_ticket("master")
+    out = call("/admin/deleteVol?name=tv", ticket=weak["ticket"])
+    assert out["code"] == CODE_DENIED
+
+    # topology mutations are gated too: no unauthenticated bogus-node
+    # registration or heartbeat cursor wipes
+    assert call("/dataNode/add?id=999&addr=evil:1")["code"] == CODE_DENIED
+    assert call("/dataNode/add?id=999&addr=h999:1",
+                ticket=grant["ticket"])["code"] == CODE_OK
+    assert call("/node/heartbeat?id=999")["code"] == CODE_DENIED
