@@ -69,3 +69,20 @@ def build_router(node: AuthNode, admin_secret: bytes | None = None) -> Router:
 
     r.post("/admin/:op", admin_dispatch)
     return r
+
+
+class RemoteAuthNode:
+    """HTTP twin of the in-process AuthNode ticket surface: lets AuthClient /
+    RenewingTicket target a remote authnode daemon (sdk/auth over the wire)."""
+
+    def __init__(self, addrs: list[str]):
+        from chubaofs_tpu.rpc.client import RPCClient
+
+        self.rpc = RPCClient(list(addrs), retries=3)
+
+    def get_ticket(self, client_id: str, service_id: str, verifier: str,
+                   ts: float) -> dict:
+        return self.rpc.post("/client/getticket", {
+            "client_id": client_id, "service_id": service_id,
+            "verifier": verifier, "ts": ts,
+        })

@@ -186,6 +186,37 @@ def verify_ticket(service_id: str, service_key: bytes, ticket_b64: str,
     return claims
 
 
+class RenewingTicket:
+    """Callable ticket provider with expiry-aware renewal.
+
+    Daemons hold CLIENT CREDENTIALS, never a static ticket: a ticket is
+    TTL'd (TICKET_TTL), so anything long-running must re-acquire before
+    expiry or the cluster goes read-only an hour after boot. refresh()
+    drops the cache (callers invoke it when the server answers denied —
+    e.g. after an authnode-side capability change)."""
+
+    def __init__(self, auth_client: "AuthClient", service_id: str,
+                 margin: float = 300.0):
+        import threading
+
+        self.auth = auth_client
+        self.service_id = service_id
+        self.margin = margin
+        self._grant: dict | None = None
+        self._lock = threading.Lock()
+
+    def __call__(self) -> str:
+        with self._lock:
+            if (self._grant is None
+                    or time.time() > self._grant["exp"] - self.margin):
+                self._grant = self.auth.get_ticket(self.service_id)
+            return self._grant["ticket"]
+
+    def refresh(self) -> None:
+        with self._lock:
+            self._grant = None
+
+
 class AuthClient:
     """Client-side ticket acquisition (sdk/auth analog)."""
 

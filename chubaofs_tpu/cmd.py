@@ -62,6 +62,24 @@ def _log(daemon: str, msg: str) -> None:
     print(f"[{daemon}] {msg}", file=sys.stderr, flush=True)
 
 
+def _admin_ticket(cfg: dict):
+    """Ticket credential for ticket-gated masters. Preferred: authnode client
+    credentials (authAddrs + authClientId + authClientKey b64) — a renewing
+    provider that outlives TICKET_TTL. Fallback: a static `adminTicket`
+    string (expires after the TTL; fine for tooling, wrong for daemons)."""
+    if cfg.get("authAddrs") and cfg.get("authClientId") and cfg.get("authClientKey"):
+        import base64
+
+        from chubaofs_tpu.authnode.api import RemoteAuthNode
+        from chubaofs_tpu.authnode.server import AuthClient, RenewingTicket
+
+        client = AuthClient(RemoteAuthNode(cfg["authAddrs"]),
+                            cfg["authClientId"],
+                            base64.b64decode(cfg["authClientKey"]))
+        return RenewingTicket(client, "master")
+    return cfg.get("adminTicket")
+
+
 def _make_net(node_id: int, peers: dict[int, str], cfg: dict) -> TcpNet:
     """TcpNet with the cluster secret from config. Deployments binding raft
     off-loopback MUST set `raftSecret` (TcpNet refuses the well-known default
@@ -301,6 +319,10 @@ class MasterDaemon(_Daemon):
             return
         self.master.check_meta_partitions()
         self.master.refresh_dp_hosts()
+        # liveness sweep: stale-heartbeat nodes go inactive, their data
+        # partitions demote to read-only until they come back
+        self.master.check_node_liveness(timeout=10 * HEARTBEAT_INTERVAL)
+        self.master.check_data_partitions()
         now = time.time()
         for vol in list(self.sm.volumes.values()):
             for mp in vol.meta_partitions:
@@ -346,7 +368,7 @@ class MetaNodeDaemon(_Daemon):
         self.service = MetaService(self.metanode, host=host, port=port)
         self.addr = _advertise(self.service.addr, cfg)
         self.mc = MasterClient(cfg["masterAddrs"],
-                               admin_ticket=cfg.get("adminTicket"))
+                               admin_ticket=_admin_ticket(cfg))
         self.ticker = TickLoop([self.raft], interval=cfg.get("tickInterval", 0.02))
         self.ticker.start()
         try:
@@ -495,7 +517,7 @@ class DataNodeDaemon(_Daemon):
         self.datanode.start()
         self.addr = _advertise(self.datanode.addr, cfg)
         self.mc = MasterClient(cfg["masterAddrs"],
-                               admin_ticket=cfg.get("adminTicket"))
+                               admin_ticket=_admin_ticket(cfg))
         self.ticker = TickLoop([self.raft], interval=cfg.get("tickInterval", 0.02))
         self.ticker.start()
         try:
@@ -616,7 +638,8 @@ class ObjectNodeDaemon(_Daemon):
         from chubaofs_tpu.sdk.cluster import RemoteCluster
 
         self.cluster = RemoteCluster(cfg["masterAddrs"],
-                                     access_addrs=cfg.get("accessAddrs"))
+                                     access_addrs=cfg.get("accessAddrs"),
+                                     admin_ticket=_admin_ticket(cfg))
         users = cfg.get("users")
         if users is None:
             svc_secret = cfg.get("serviceSecret")

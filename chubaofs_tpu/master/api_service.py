@@ -71,12 +71,12 @@ class MasterAPI:
         g("/client/partitions", self._w(self.client_partitions, leader=False))
         g("/client/metaPartitions", self._w(self.client_meta_partitions, leader=False))
         g("/client/vol", self._w(self.get_vol, leader=False))
-        # topology mutations are gated like the rest of the admin surface —
-        # registering a bogus node or wiping cursors via heartbeat is at least
-        # as damaging as a decommission (daemons carry cfg adminTicket)
-        g("/dataNode/add", self._w(self.add_node_data, admin=True))
-        g("/metaNode/add", self._w(self.add_node_meta, admin=True))
-        g("/node/heartbeat", self._w(self.node_heartbeat, admin=True))
+        # topology mutations are gated too, but under the NODE capability:
+        # a datanode's on-disk credential must let it register/heartbeat
+        # without also granting deleteVol-class admin power (least privilege)
+        g("/dataNode/add", self._w(self.add_node_data, admin=True, cap="node"))
+        g("/metaNode/add", self._w(self.add_node_meta, admin=True, cap="node"))
+        g("/node/heartbeat", self._w(self.node_heartbeat, admin=True, cap="node"))
         g("/dataNode/decommission", self._w(self.decommission_data, admin=True))
         g("/metaNode/decommission", self._w(self.decommission_meta, admin=True))
         g("/user/create", self._w(self.user_create, admin=True))
@@ -90,9 +90,12 @@ class MasterAPI:
         r.post("/graphql", GraphQLAPI(self.master).handle)
         return r
 
-    def _w(self, fn, leader: bool = True, admin: bool = False):
+    def _w(self, fn, leader: bool = True, admin: bool = False,
+           cap: str = "admin"):
         """Wrap a handler: QoS gate + ticket gate + leader gate + MasterError
-        → envelope."""
+        → envelope. `cap` names the capability the ticket must carry
+        ("master:admin" for destructive ops, "master:node" for node
+        registration/heartbeat — node credentials never hold admin power)."""
 
         def handler(req: Request):
             if not self.qos.allow(req.path):
@@ -103,11 +106,12 @@ class MasterAPI:
 
                 try:
                     verify_ticket("master", self.admin_ticket_key,
-                                  req.header("x-cfs-ticket"), action="admin")
+                                  req.header("x-cfs-ticket"), action=cap)
                 except Exception as e:  # TicketError, malformed b64, ...
                     return Response.json(
                         envelope(None, CODE_DENIED,
-                                 f"admin ticket required: {e}"), status=200)
+                                 f"master:{cap} ticket required: {e}"),
+                        status=200)
             if leader and not self.master.is_leader:
                 lead = self.master.raft.leader_of(MASTER_GROUP)
                 addr = self.leader_addr_of(lead) if lead is not None else ""
@@ -272,14 +276,21 @@ class MasterClient:
 
     def __init__(self, hosts: list[str], retries: int = 4,
                  auth_secret: bytes | None = None,
-                 admin_ticket: str | None = None):
+                 admin_ticket=None):
+        """admin_ticket: authnode capability ticket — a static b64 string, or
+        a CALLABLE returning one (authnode.server.RenewingTicket) so daemons
+        outlive TICKET_TTL; a callable with .refresh() gets one re-acquire
+        attempt when the master answers CODE_DENIED."""
         self.auth_secret = auth_secret
-        self.admin_ticket = admin_ticket  # authnode master:admin capability
+        self.admin_ticket = admin_ticket
         self.rpc = RPCClient(hosts, retries=retries, auth_secret=auth_secret)
         self.leader_hint: str | None = None
 
     def _headers(self) -> dict:
-        return {"x-cfs-ticket": self.admin_ticket} if self.admin_ticket else {}
+        t = self.admin_ticket
+        if t is None:
+            return {}
+        return {"x-cfs-ticket": t() if callable(t) else t}
 
     @staticmethod
     def _path(route: str, **params) -> str:
@@ -293,6 +304,7 @@ class MasterClient:
 
     def call(self, path: str) -> object:
         last_msg = "no reply"
+        denied_retried = False
         for _ in range(4):
             if self.leader_hint:
                 rpc = RPCClient([self.leader_hint], retries=1,
@@ -323,6 +335,15 @@ class MasterClient:
 
                 last_msg = out.get("msg", "rate limited")
                 time.sleep(0.2)
+                continue
+            if code == CODE_DENIED and callable(self.admin_ticket) \
+                    and not denied_retried:
+                # expired/stale ticket with a renewing provider: one
+                # re-acquire, then retry the call
+                denied_retried = True
+                refresh = getattr(self.admin_ticket, "refresh", None)
+                if refresh is not None:
+                    refresh()
                 continue
             last_msg = out.get("msg", "error")
             raise MasterError(last_msg)

@@ -216,6 +216,8 @@ class MasterSM(StateMachine):
         if n is None:
             raise MasterError(f"unknown node {node_id}")
         n.last_heartbeat = max(n.last_heartbeat, now)
+        if n.status == "inactive":
+            n.status = "active"  # liveness recovery; decommissioned stays out
         n.partition_count = partition_count
         # a dict REPLACES the cursor set (even when empty — a restarted node
         # reports no partitions, and the ensure sweep must see that to re-send
@@ -707,6 +709,47 @@ class Master:
                     self.metanode_hook(new_pid, split_at, INF, peers)
                 splits += 1
         return splits
+
+    def check_node_liveness(self, timeout: float = 10.0,
+                            now: float | None = None) -> list[int]:
+        """Mark nodes whose heartbeat went stale as INACTIVE so placement and
+        client views route around them; a returning heartbeat reactivates
+        (master/cluster.go scheduleToCheckHeartbeat analog). Decommissioned
+        nodes are left alone. Returns the node ids newly marked."""
+        if not self.is_leader:
+            return []
+        now = time.time() if now is None else now
+        out = []
+        for n in list(self.sm.nodes.values()):
+            if n.status != "active":
+                continue
+            if n.last_heartbeat and now - n.last_heartbeat > timeout:
+                self._apply("set_node_status", node_id=n.node_id,
+                            status="inactive")
+                out.append(n.node_id)
+        return out
+
+    def check_data_partitions(self) -> int:
+        """Demote data partitions with a non-schedulable replica to read-only
+        and promote them back when every peer is healthy (the reference's
+        checkDataPartitions loop marking partitions unavailable). Clients only
+        see rw partitions (data_partition_views), so writes route around dead
+        replicas while reads still work through the survivors."""
+        if not self.is_leader:
+            return 0
+        changed = 0
+        for vol in list(self.sm.volumes.values()):
+            for dp in vol.data_partitions:
+                healthy = all(
+                    self.sm.nodes.get(p) is not None
+                    and self.sm.nodes[p].status == "active"
+                    for p in dp.peers)
+                want = "rw" if healthy else "ro"
+                if dp.status in ("rw", "ro") and dp.status != want:
+                    self._apply("set_dp_status", vol_name=vol.name,
+                                partition_id=dp.partition_id, status=want)
+                    changed += 1
+        return changed
 
     def refresh_leaders(self, leader_of) -> None:
         """Record partition leaders into the view (client routing hint)."""

@@ -67,8 +67,9 @@ class RemoteDataBackend:
 
 
 class RemoteCluster:
-    def __init__(self, master_addrs: list[str], access_addrs: list[str] | None = None):
-        self.mc = MasterClient(master_addrs)
+    def __init__(self, master_addrs: list[str], access_addrs: list[str] | None = None,
+                 admin_ticket=None):
+        self.mc = MasterClient(master_addrs, admin_ticket=admin_ticket)
         self.adapter = _MasterAdapter(self.mc)
         self.access_addrs = access_addrs or []
         self._metanodes: dict[int, RemoteMetaNode] = {}

@@ -313,9 +313,132 @@ def test_master_admin_requires_authnode_ticket(tmp_path, master):
     out = call("/admin/deleteVol?name=tv", ticket=weak["ticket"])
     assert out["code"] == CODE_DENIED
 
-    # topology mutations are gated too: no unauthenticated bogus-node
-    # registration or heartbeat cursor wipes
+    # topology mutations are gated under the NODE capability: no
+    # unauthenticated registration/heartbeat, and least privilege both ways —
+    # an admin ticket doesn't heartbeat, a node ticket doesn't deleteVol
+    node_key = an.create_key("dn1", "client", caps=["master:node"])
+    node_grant = AuthClient(an, "dn1", node_key).get_ticket("master")
     assert call("/dataNode/add?id=999&addr=evil:1")["code"] == CODE_DENIED
     assert call("/dataNode/add?id=999&addr=h999:1",
-                ticket=grant["ticket"])["code"] == CODE_OK
-    assert call("/node/heartbeat?id=999")["code"] == CODE_DENIED
+                ticket=grant["ticket"])["code"] == CODE_DENIED
+    assert call("/dataNode/add?id=999&addr=h999:1",
+                ticket=node_grant["ticket"])["code"] == CODE_OK
+    assert call("/node/heartbeat?id=999",
+                ticket=node_grant["ticket"])["code"] == CODE_OK
+    assert call("/admin/deleteVol?name=tv",
+                ticket=node_grant["ticket"])["code"] == CODE_DENIED
+
+
+def test_renewing_ticket_provider_and_denied_retry(tmp_path, master):
+    """Daemons hold credentials, not tickets: the provider renews before
+    expiry, and MasterClient re-acquires once on CODE_DENIED."""
+    import base64
+
+    from chubaofs_tpu.authnode import AUTH_GROUP
+    from chubaofs_tpu.authnode.server import (
+        AuthClient, AuthNode, KeystoreSM, RenewingTicket)
+    from chubaofs_tpu.master.api_service import MasterAPI, MasterClient
+    from chubaofs_tpu.rpc.server import RPCServer
+
+    net = InProcNet()
+    araft = MultiRaft(9, net)
+    asm = KeystoreSM()
+    araft.create_group(AUTH_GROUP, [9], asm)
+    assert run_until(net, lambda: araft.is_leader(AUTH_GROUP))
+    an = AuthNode(araft, asm)
+    svc_key = an.create_key("master", "service")
+    op_key = an.create_key("op", "client", caps=["master:admin"])
+    auth_client = AuthClient(an, "op", op_key)
+
+    # caching: one grant serves repeated calls; a tiny margin forces renewal
+    calls = {"n": 0}
+    orig = auth_client.get_ticket
+
+    def counting(service_id):
+        calls["n"] += 1
+        return orig(service_id)
+
+    auth_client.get_ticket = counting
+    prov = RenewingTicket(auth_client, "master")
+    t1, t2 = prov(), prov()
+    assert t1 == t2 and calls["n"] == 1
+    prov.refresh()
+    prov()
+    assert calls["n"] == 2
+
+    # refresh margin beyond the TTL: every call re-acquires
+    eager = RenewingTicket(auth_client, "master", margin=10 ** 9)
+    eager(), eager()
+    assert calls["n"] == 4
+
+    # end-to-end over HTTP: a provider whose cached ticket went bad gets ONE
+    # re-acquire when the master answers CODE_DENIED
+    _register_grid(master, "meta", zones=3, per_zone=1, base=100)
+    api = MasterAPI(master, admin_ticket_key=svc_key)
+    srv = RPCServer(api.router).start()
+    try:
+        class Flaky:
+            def __init__(self):
+                self.t = base64.b64encode(b"garbage-ticket").decode()
+
+            def __call__(self):
+                return self.t
+
+            def refresh(self):
+                self.t = auth_client.get_ticket("master")["ticket"]
+
+        mc = MasterClient([srv.addr], admin_ticket=Flaky())
+        vol = mc.create_volume("rtvol", cold=True, dp_count=0)
+        assert vol["name"] == "rtvol"
+    finally:
+        srv.stop()
+
+
+# -- liveness + partition health loops (master/cluster.go scheduleTask) --------
+
+
+def test_node_liveness_and_dp_health(master):
+    """Stale heartbeats mark nodes inactive, their data partitions demote to
+    read-only, and a returning heartbeat restores both."""
+    _register_grid(master, "meta", zones=3, per_zone=1, base=100)
+    _register_grid(master, "data", zones=3, per_zone=1, base=200)
+    now = time.time()
+    for nid in (200, 201, 202):
+        master.heartbeat(nid)
+    vol = master.create_volume("lv", data_partitions=1)
+    dp = vol.data_partitions[0]
+    assert dp.status == "rw"
+
+    # node 200 goes silent while everyone else keeps beating
+    for n in master.sm.nodes.values():
+        n.last_heartbeat = now
+    master.sm.nodes[200].last_heartbeat = now - 100
+    dead = master.check_node_liveness(timeout=10.0, now=now)
+    assert dead == [200]
+    assert master.sm.nodes[200].status == "inactive"
+    assert master.check_data_partitions() == 1
+    assert master.sm.volumes["lv"].data_partitions[0].status == "ro"
+    # clients only see rw partitions
+    assert master.data_partition_views("lv") == []
+    # inactive nodes are not placement candidates
+    with pytest.raises(MasterError, match="need 3"):
+        master.create_volume("lv2", data_partitions=1)
+
+    # the node comes back: heartbeat reactivates, partition promotes to rw
+    master.heartbeat(200)
+    assert master.check_data_partitions() == 1
+    assert master.sm.volumes["lv"].data_partitions[0].status == "rw"
+    assert len(master.data_partition_views("lv")) == 1
+
+
+def test_liveness_leaves_decommissioned_alone(master):
+    _register_grid(master, "meta", zones=3, per_zone=2, base=100)
+    master.create_volume("dv", data_partitions=0, cold=True)
+    victim = master.sm.volumes["dv"].meta_partitions[0].peers[0]
+    master.decommission_metanode(victim)
+    assert master.sm.nodes[victim].status == "decommissioned"
+    master.check_node_liveness(timeout=0.0, now=time.time() + 3600)
+    assert master.sm.nodes[victim].status == "decommissioned"
+    # and a (buggy/stray) heartbeat must NOT resurrect it into placement
+    master.heartbeat(victim)
+    assert master.sm.nodes[victim].status == "decommissioned"
