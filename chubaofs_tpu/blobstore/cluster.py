@@ -49,6 +49,7 @@ class MiniCluster:
         inspected = self.scheduler.inspect_volumes()
         polled = self.scheduler.poll_repair_topic()
         disk_tasks = self.scheduler.check_disks()
+        balance_task = self.scheduler.check_balance()
         ran = 0
         while self.worker.run_once():
             ran += 1
@@ -58,6 +59,7 @@ class MiniCluster:
             "inspect_msgs": inspected,
             "repair_msgs": polled,
             "disk_tasks": len(disk_tasks),
+            "balance_tasks": 1 if balance_task else 0,
             "tasks_ran": ran,
             "deletes": deleted,
             "compacted_bytes": compacted,

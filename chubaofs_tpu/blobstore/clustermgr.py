@@ -325,6 +325,10 @@ class ClusterMgr:
             raise ClusterError(f"unknown volume {vid}")
         unit = vol.units[index]
         d = self.disks[new_disk_id]
+        old = self.disks.get(unit.disk_id)
+        if old is not None and old.chunk_count > 0:
+            old.chunk_count -= 1  # the chunk moved WITH the unit
+        d.chunk_count += 1
         unit.epoch += 1
         unit.disk_id = new_disk_id
         unit.node_id = d.node_id

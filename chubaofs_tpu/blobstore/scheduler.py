@@ -237,6 +237,45 @@ class Scheduler:
         """Manual decommission -> migrate everything off (disk_drop analog)."""
         return self._new_task(kind=KIND_DISK_DROP, disk_id=disk_id)
 
+    def check_balance(self, min_gap: int = 3) -> Task | None:
+        """Even out chunk counts (scheduler/balancer.go): when the most-loaded
+        normal disk leads the least-loaded same-AZ disk by >= min_gap chunks,
+        create ONE balance task moving a single volume unit off it. Gated by
+        SWITCH_BALANCE; one rebalance in flight at a time."""
+        from chubaofs_tpu.blobstore.taskswitch import SWITCH_BALANCE
+
+        if not self.switches.enabled(SWITCH_BALANCE):
+            return None
+        if any(t.state in (TASK_PREPARED, TASK_WORKING)
+               for t in self.tasks(KIND_BALANCE)):
+            return None
+        by_az: dict[int, list] = {}
+        for d in self.cm.disks.values():
+            if d.status == DISK_NORMAL:
+                by_az.setdefault(d.az, []).append(d)
+        # balance is intrinsically per-AZ (moves never cross AZs): evaluate
+        # every AZ's own spread, not one global maximum
+        for az, disks in sorted(by_az.items()):
+            if len(disks) < 2:
+                continue
+            src = max(disks, key=lambda d: d.chunk_count)
+            low = min(d.chunk_count for d in disks if d.disk_id != src.disk_id)
+            if src.chunk_count - low < min_gap:
+                continue
+            for vol, unit in self.cm.volumes_on_disk(src.disk_id):
+                try:
+                    dest = self.pick_dest_disk(
+                        exclude={u.disk_id for u in vol.units}, az=az)
+                except RuntimeError:
+                    continue
+                # the move must CONVERGE: a destination nearly as loaded as
+                # the source would just ping-pong units back and forth
+                if self.cm.disks[dest].chunk_count + min_gap > src.chunk_count:
+                    continue
+                return self._new_task(kind=KIND_BALANCE, vid=vol.vid,
+                                      disk_id=src.disk_id)
+        return None
+
     def pick_dest_disk(self, exclude: set[int], az: int) -> int:
         """Least-loaded normal disk in the AZ, outside the exclusion set
         (source disk + every disk already hosting a unit of the volume)."""
@@ -334,7 +373,9 @@ class RepairWorker:
         try:
             if task.kind == KIND_SHARD_REPAIR:
                 self._repair_shards(task.vid, task.bid, task.bad_idx)
-            elif task.kind in (KIND_DISK_REPAIR, KIND_DISK_DROP, KIND_BALANCE):
+            elif task.kind == KIND_BALANCE:
+                self._balance_unit(task)
+            elif task.kind in (KIND_DISK_REPAIR, KIND_DISK_DROP):
                 self._migrate_disk(task)
             self.sched.report_task(task.task_id, True)
         except Exception as e:
@@ -462,64 +503,105 @@ class RepairWorker:
         source_broken = self.cm.disks[task.disk_id].status != DISK_NORMAL
         affected = self.cm.volumes_on_disk(task.disk_id)
         for vol, unit in affected:
-            t = vol.tactic()
-            # every bid in this volume, seen from any unit (source included when healthy)
-            bids: set[int] = set()
-            for u in vol.units:
-                if u.disk_id == task.disk_id and source_broken:
-                    continue
-                node = self.nodes.get(u.node_id)
-                if node is None:
-                    continue
-                try:
-                    bids.update(m.bid for m in node.list_shards(u.vuid))
-                except Exception:
-                    continue
-            # phase 1: source copies or reconstruct futures (submitted together so
-            # the codec service batches them into shared device calls)
-            rows: dict[int, bytes] = {}
-            futures: dict[int, object] = {}
-            for bid in sorted(bids):
-                if not source_broken:
-                    try:
-                        node = self.nodes[unit.node_id]
-                        rows[bid] = node.get_shard(unit.vuid, bid)
-                        continue
-                    except Exception:
-                        pass  # fall through to reconstruct
-                stripe, present, _ = self._gather(vol, t, bid)
-                missing = [i for i in range(t.N + t.M) if i not in present]
-                if unit.index in present:
-                    rows[bid] = stripe[unit.index].tobytes()
-                elif unit.index < t.global_count:
-                    # repair with the FULL missing set: zero-filled absent rows
-                    # must never be treated as survivors
-                    futures[bid] = self.codec.reconstruct(t.N, t.M, stripe, missing)
-                else:
-                    # LRC local parity: complete the globals, then re-encode
-                    # this AZ's local stripe to regenerate the lost row
-                    if missing:
-                        stripe = self.codec.reconstruct(t.N, t.M, stripe, missing).result()
-                    local_n = (t.N + t.M) // t.az_count
-                    local_m = t.L // t.az_count
-                    for idx, _, _ in t.local_stripes():
-                        if unit.index in idx:
-                            full = self.codec.encode(
-                                local_n, local_m, stripe[idx[:local_n]]
-                            ).result()
-                            pos = idx[local_n:].index(unit.index)
-                            rows[bid] = full[local_n + pos].tobytes()
-                            break
-            for bid, fut in futures.items():
-                rows[bid] = fut.result()[unit.index].tobytes()
-
-            dest = self._dest_for(vol, task.disk_id)
-            new_unit = self.cm.update_volume_unit(vol.vid, unit.index, dest)
-            dest_node = self.nodes[new_unit.node_id]
-            dest_node.create_vuid(new_unit.vuid, new_unit.disk_id)
-            for bid, payload in rows.items():
-                dest_node.put_shard(new_unit.vuid, bid, payload)
+            self._migrate_unit(vol, unit, task.disk_id, source_broken)
         self.cm.set_disk_status(task.disk_id, DISK_DROPPED)
+
+    def _balance_unit(self, task: Task):
+        """Move ONE volume unit off an (otherwise healthy) overloaded disk."""
+        vol = self.cm.get_volume(task.vid)
+        unit = next((u for u in vol.units if u.disk_id == task.disk_id), None)
+        if unit is None:
+            # a previous attempt already re-homed the mapping but may have
+            # died mid-copy (mapping updates before the shard writes): sweep
+            # the volume's stripes through the repair plane rather than
+            # declaring victory over a silently degraded stripe
+            self._enqueue_missing(vol)
+            return
+        source_broken = self.cm.disks[task.disk_id].status != DISK_NORMAL
+        self._migrate_unit(vol, unit, task.disk_id, source_broken)
+
+    def _enqueue_missing(self, vol: VolumeInfo):
+        """Probe every stripe position of every bid in the volume; feed any
+        missing/unreadable position to the repair topic."""
+        t = vol.tactic()
+        bids: set[int] = set()
+        for u in vol.units:
+            node = self.nodes.get(u.node_id)
+            if node is None:
+                continue
+            try:
+                bids.update(m.bid for m in node.list_shards(u.vuid))
+            except Exception:
+                continue
+        for bid in sorted(bids):
+            have = self._probe(vol, bid, range(t.total))
+            bad = [i for i in range(t.total) if i not in have]
+            if bad:
+                self.sched.proxy.send_shard_repair(vol.vid, bid, bad,
+                                                   "balance_retry")
+
+    def _migrate_unit(self, vol: VolumeInfo, unit, source_disk_id: int,
+                      source_broken: bool):
+        """Re-home one stripe position: copy (healthy source) or reconstruct
+        the rows, then update the clustermgr mapping and write to the new
+        disk. Shared by disk-level migrate and the balancer."""
+        t = vol.tactic()
+        # every bid in this volume, seen from any unit (source included when healthy)
+        bids: set[int] = set()
+        for u in vol.units:
+            if u.disk_id == source_disk_id and source_broken:
+                continue
+            node = self.nodes.get(u.node_id)
+            if node is None:
+                continue
+            try:
+                bids.update(m.bid for m in node.list_shards(u.vuid))
+            except Exception:
+                continue
+        # phase 1: source copies or reconstruct futures (submitted together so
+        # the codec service batches them into shared device calls)
+        rows: dict[int, bytes] = {}
+        futures: dict[int, object] = {}
+        for bid in sorted(bids):
+            if not source_broken:
+                try:
+                    node = self.nodes[unit.node_id]
+                    rows[bid] = node.get_shard(unit.vuid, bid)
+                    continue
+                except Exception:
+                    pass  # fall through to reconstruct
+            stripe, present, _ = self._gather(vol, t, bid)
+            missing = [i for i in range(t.N + t.M) if i not in present]
+            if unit.index in present:
+                rows[bid] = stripe[unit.index].tobytes()
+            elif unit.index < t.global_count:
+                # repair with the FULL missing set: zero-filled absent rows
+                # must never be treated as survivors
+                futures[bid] = self.codec.reconstruct(t.N, t.M, stripe, missing)
+            else:
+                # LRC local parity: complete the globals, then re-encode
+                # this AZ's local stripe to regenerate the lost row
+                if missing:
+                    stripe = self.codec.reconstruct(t.N, t.M, stripe, missing).result()
+                local_n = (t.N + t.M) // t.az_count
+                local_m = t.L // t.az_count
+                for idx, _, _ in t.local_stripes():
+                    if unit.index in idx:
+                        full = self.codec.encode(
+                            local_n, local_m, stripe[idx[:local_n]]
+                        ).result()
+                        pos = idx[local_n:].index(unit.index)
+                        rows[bid] = full[local_n + pos].tobytes()
+                        break
+        for bid, fut in futures.items():
+            rows[bid] = fut.result()[unit.index].tobytes()
+
+        dest = self._dest_for(vol, source_disk_id)
+        new_unit = self.cm.update_volume_unit(vol.vid, unit.index, dest)
+        dest_node = self.nodes[new_unit.node_id]
+        dest_node.create_vuid(new_unit.vuid, new_unit.disk_id)
+        for bid, payload in rows.items():
+            dest_node.put_shard(new_unit.vuid, bid, payload)
 
     def _dest_for(self, vol: VolumeInfo, source_disk_id: int) -> int:
         vol_disks = {u.disk_id for u in vol.units}
