@@ -319,6 +319,22 @@ class Chunk:
         with self._lock:
             return sorted(self.shards.values(), key=lambda m: m.bid)
 
+    def destroy(self):
+        """Delete the chunk outright: datafile, shard metas, tombstones, gen
+        marker. Used when a volume unit is re-homed off this disk."""
+        with self._lock:
+            self._f.close()
+            keys = [k for k, _ in self._db.scan(
+                prefix=f"s/{self.chunk_id}/".encode())]
+            keys.append(self._gen_key())
+            self._db.write_batch(deletes=keys)
+            try:
+                os.unlink(self._data_path)
+            except OSError:
+                pass
+            self.shards.clear()
+            self.tombstones.clear()
+
     def close(self):
         self._f.close()
 
@@ -452,6 +468,21 @@ class BlobNode:
     def lose_shard(self, vuid: int, bid: int) -> None:
         """Simulate media loss of one shard (no delete tombstone)."""
         self._chunk(vuid).lose(bid)
+
+    def drop_vuid(self, vuid: int) -> None:
+        """Release a re-homed volume unit's chunk: the space a balance/migrate
+        moved away must actually free on the source disk. Idempotent."""
+        with self._lock:
+            loc = self._chunk_of_vuid.pop(vuid, None)
+        if loc is None:
+            return
+        disk_id, cid = loc
+        disk = self.disks[disk_id]
+        with disk._lock:
+            chunk = disk.chunks.pop(cid, None)
+        if chunk is not None:
+            chunk.destroy()
+            disk._persist()
 
     def has_tombstone(self, vuid: int, bid: int) -> bool:
         """True when this bid was DELETED here (vs never written / lost)."""

@@ -273,7 +273,8 @@ class Scheduler:
                 if self.cm.disks[dest].chunk_count + min_gap > src.chunk_count:
                     continue
                 return self._new_task(kind=KIND_BALANCE, vid=vol.vid,
-                                      disk_id=src.disk_id)
+                                      disk_id=src.disk_id,
+                                      dest_disk_id=dest)
         return None
 
     def pick_dest_disk(self, exclude: set[int], az: int) -> int:
@@ -518,7 +519,8 @@ class RepairWorker:
             self._enqueue_missing(vol)
             return
         source_broken = self.cm.disks[task.disk_id].status != DISK_NORMAL
-        self._migrate_unit(vol, unit, task.disk_id, source_broken)
+        self._migrate_unit(vol, unit, task.disk_id, source_broken,
+                           dest_disk_id=task.dest_disk_id or None)
 
     def _enqueue_missing(self, vol: VolumeInfo):
         """Probe every stripe position of every bid in the volume; feed any
@@ -541,7 +543,7 @@ class RepairWorker:
                                                    "balance_retry")
 
     def _migrate_unit(self, vol: VolumeInfo, unit, source_disk_id: int,
-                      source_broken: bool):
+                      source_broken: bool, dest_disk_id: int | None = None):
         """Re-home one stripe position: copy (healthy source) or reconstruct
         the rows, then update the clustermgr mapping and write to the new
         disk. Shared by disk-level migrate and the balancer."""
@@ -596,12 +598,29 @@ class RepairWorker:
         for bid, fut in futures.items():
             rows[bid] = fut.result()[unit.index].tobytes()
 
-        dest = self._dest_for(vol, source_disk_id)
+        dest = dest_disk_id
+        if dest is not None:
+            # a destination pinned at scheduling time may have gone stale
+            d = self.cm.disks.get(dest)
+            if d is None or d.status != DISK_NORMAL or \
+                    dest in {u.disk_id for u in vol.units}:
+                dest = None
+        if dest is None:
+            dest = self._dest_for(vol, source_disk_id)
+        old_vuid, old_node_id = unit.vuid, unit.node_id
         new_unit = self.cm.update_volume_unit(vol.vid, unit.index, dest)
         dest_node = self.nodes[new_unit.node_id]
         dest_node.create_vuid(new_unit.vuid, new_unit.disk_id)
         for bid, payload in rows.items():
             dest_node.put_shard(new_unit.vuid, bid, payload)
+        # the move must FREE the source: drop the superseded chunk (best
+        # effort — an unreachable/broken source just leaks until re-imaged)
+        old_node = self.nodes.get(old_node_id)
+        if old_node is not None:
+            try:
+                old_node.drop_vuid(old_vuid)
+            except Exception:
+                pass
 
     def _dest_for(self, vol: VolumeInfo, source_disk_id: int) -> int:
         vol_disks = {u.disk_id for u in vol.units}

@@ -8,13 +8,16 @@ volumes; object data rides the same meta+data planes as the POSIX client —
 EC-on-TPU for cold volumes — so S3 and FUSE views of a volume agree
 (CHANGELOG.md:12's blobstore docking).
 
-Supported S3 actions: ListBuckets, Create/Delete/Head Bucket,
-GetBucketLocation, ListObjects V1/V2, Put/Get/Head/Delete/Copy Object,
-DeleteObjects (batch), Range GET, Bucket+Object ACL, Bucket Policy,
-Bucket CORS (+ preflight), Bucket+Object Tagging, full multipart
-(Initiate/UploadPart/UploadPartCopy/List/Complete/Abort/ListUploads),
-Bucket Versioning (Put/Get, ListObjectVersions, versionId GET/DELETE,
-delete markers), Bucket Lifecycle (Put/Get/Delete + expiry sweeper),
+Supported S3 actions (~60): ListBuckets, Create/Delete/Head Bucket,
+GetBucketLocation, ListObjects V1/V2 (continuation tokens, delimiters),
+Put/Get/Head/Delete Object, CopyObject (COPY/REPLACE metadata directive),
+DeleteObjects (batch + Quiet), Range GET, GetObjectAttributes,
+Bucket+Object ACL (grant XML + canned x-amz-acl), Bucket Policy +
+GetBucketPolicyStatus, Bucket CORS (+ preflight), Bucket+Object Tagging,
+full multipart (Initiate/UploadPart/UploadPartCopy with source ranges/
+List/Complete/Abort/ListUploads), Bucket Versioning (Put/Get,
+ListObjectVersions, versionId GET/HEAD/DELETE, delete markers, Suspended
+semantics), Bucket Lifecycle (Put/Get/Delete + expiry sweeper),
 presigned URLs (SigV4 query auth and SigV2 Expires/Signature).
 """
 
@@ -185,6 +188,8 @@ class ObjectNode:
         r.get("/:bucket", w(self.get_bucket_location), queries={"location": None})
         r.get("/:bucket", w(self.get_bucket_acl), queries={"acl": None})
         r.put("/:bucket", w(self.put_bucket_acl), queries={"acl": None})
+        r.get("/:bucket", w(self.get_bucket_policy_status),
+              queries={"policyStatus": None})
         r.get("/:bucket", w(self.get_bucket_policy), queries={"policy": None})
         r.put("/:bucket", w(self.put_bucket_policy), queries={"policy": None})
         r.delete("/:bucket", w(self.delete_bucket_policy), queries={"policy": None})
@@ -211,6 +216,8 @@ class ObjectNode:
         r.head("/:bucket", w(self.head_bucket))
         r.handle("OPTIONS", "/:bucket", w(self.preflight))
         # object sub-resources
+        r.get("/:bucket/*key", w(self.get_object_attributes),
+              queries={"attributes": None})
         r.get("/:bucket/*key", w(self.get_object_acl), queries={"acl": None})
         r.put("/:bucket/*key", w(self.put_object_acl), queries={"acl": None})
         r.get("/:bucket/*key", w(self.get_object_tagging), queries={"tagging": None})
@@ -380,16 +387,32 @@ class ObjectNode:
         src = req.header("x-amz-copy-source")
         if src:
             return self._copy_object(req, vol, key, src)
+        acl = self._parse_canned_acl(req, vol, key)  # validate BEFORE writing
         vid = self._version_prologue(vol, key)
         user_meta = {k[len("x-amz-meta-"):]: v for k, v in req.headers.items()
                      if k.startswith("x-amz-meta-")}
         etag = vol.put_object(key, req.body, req.header("content-type"),
                               user_meta or None)
         self._version_epilogue(vol, key, vid)
+        if acl is not None:
+            vol.fs.setxattr("/" + key, XATTR_ACL, acl.to_json())
         headers = {"ETag": f'"{etag}"'}
         if vid is not None:
             headers["x-amz-version-id"] = vid
         return Response(200, headers)
+
+    def _parse_canned_acl(self, req: Request, vol: OSSVolume,
+                          key: str) -> ACL | None:
+        """x-amz-acl header -> ACL, validated up front: a bad header must 400
+        before any state changes (no object written, no version consumed)."""
+        canned = req.header("x-amz-acl")
+        if not canned or key.endswith("/"):
+            return None
+        try:
+            return ACL.canned(self._owner(vol), canned)
+        except ValueError:
+            raise S3Error(400, "InvalidArgument",
+                          f"x-amz-acl {canned!r}") from None
 
     def _copy_object(self, req: Request, vol: OSSVolume, key: str, src: str):
         src = urllib.parse.unquote(src).lstrip("/")
@@ -398,10 +421,18 @@ class ObjectNode:
         src_vol = self._vol(src_bucket)
         info = src_vol.info(src_key)
         data = src_vol.get_object(src_key)
+        if req.header("x-amz-metadata-directive", "COPY").upper() == "REPLACE":
+            content_type = req.header("content-type") or info["content_type"]
+            meta = {k[len("x-amz-meta-"):]: v for k, v in req.headers.items()
+                    if k.startswith("x-amz-meta-")}
+        else:
+            content_type, meta = info["content_type"], info["meta"]
+        acl = self._parse_canned_acl(req, vol, key)
         vid = self._version_prologue(vol, key)
-        etag = vol.put_object(key, data, info["content_type"],
-                              info["meta"] or None)
+        etag = vol.put_object(key, data, content_type, meta or None)
         self._version_epilogue(vol, key, vid)
+        if acl is not None:
+            vol.fs.setxattr("/" + key, XATTR_ACL, acl.to_json())
         return Response.xml(
             f"<CopyObjectResult><ETag>&quot;{etag}&quot;</ETag>"
             f"<LastModified>{OSSVolume.http_time(info['mtime'])}</LastModified>"
@@ -465,6 +496,30 @@ class ObjectNode:
         headers["Content-Length"] = str(info["size"])
         return Response(200, headers)
 
+    def get_object_attributes(self, req: Request):
+        """GetObjectAttributes: the metadata subset named by the
+        x-amz-object-attributes header, without the body."""
+        bucket, key = req.params["bucket"], req.params["key"]
+        self._check(req, bucket, ACTION_GET, key)
+        vol = self._vol(bucket)
+        vid = req.q("versionId")
+        info = vol.stat_version(key, vid) if vid else vol.info(key)
+        want = {a.strip() for a in
+                req.header("x-amz-object-attributes", "ETag,ObjectSize").split(",")}
+        parts = []
+        if "ETag" in want:
+            parts.append(f"<ETag>{esc(info['etag'])}</ETag>")
+        if "ObjectSize" in want:
+            parts.append(f"<ObjectSize>{info['size']}</ObjectSize>")
+        if "StorageClass" in want:
+            parts.append("<StorageClass>STANDARD</StorageClass>")
+        headers = {"Last-Modified": OSSVolume.http_time(info["mtime"])}
+        if vid:
+            headers["x-amz-version-id"] = vid
+        return Response(200, {**headers, "Content-Type": "application/xml"},
+                        ("<GetObjectAttributesOutput>" + "".join(parts) +
+                         "</GetObjectAttributesOutput>").encode())
+
     def delete_object(self, req: Request):
         bucket, key = req.params["bucket"], req.params["key"]
         self._check(req, bucket, ACTION_DELETE, key)
@@ -500,13 +555,15 @@ class ObjectNode:
         self._check(req, bucket, ACTION_DELETE)
         vol = self._vol(bucket)
         root = _parse_xml(req.body)
+        quiet = _text(root, "Quiet").lower() == "true"
         deleted = []
         for obj in root.iter("Object"):
             key = _text(obj, "Key")
             if key:
                 self._versioned_delete(vol, key)
                 deleted.append(key)
-        body = "".join(f"<Deleted><Key>{esc(k)}</Key></Deleted>" for k in deleted)
+        body = "" if quiet else "".join(
+            f"<Deleted><Key>{esc(k)}</Key></Deleted>" for k in deleted)
         return Response.xml(f"<DeleteResult>{body}</DeleteResult>")
 
     # -- acl ---------------------------------------------------------------------
@@ -555,6 +612,28 @@ class ObjectNode:
         return Response(200)
 
     # -- policy ------------------------------------------------------------------
+
+    def get_bucket_policy_status(self, req: Request):
+        """GetBucketPolicyStatus: IsPublic when any Allow statement grants to
+        the anonymous principal."""
+        bucket = req.params["bucket"]
+        self._check(req, bucket, ACTION_GET, perm="READ_ACP")
+        raw = self._vol(bucket).get_bucket_xattr(XATTR_POLICY)
+        public = False
+        if raw:
+            # same matcher the request path uses: IsPublic must never diverge
+            # from actual anonymous evaluation
+            pol = Policy.from_json(raw)
+            statements = pol.doc["Statement"]
+            if isinstance(statements, dict):
+                statements = [statements]
+            public = any(
+                st.get("Effect") == ALLOW
+                and Policy._principal_matches(st, None)
+                for st in statements)
+        return Response.xml(
+            f"<PolicyStatus><IsPublic>{str(public).lower()}</IsPublic>"
+            f"</PolicyStatus>")
 
     def get_bucket_policy(self, req: Request):
         bucket = req.params["bucket"]

@@ -491,3 +491,32 @@ def test_balance_retry_after_partial_move_heals(tmp_path, rng):
         assert len(c.access.get(loc)) == 500_000
     finally:
         c.close()
+
+
+def test_balance_frees_source_chunk(tmp_path, rng):
+    """A balance move must reclaim the source disk's chunk file, not just the
+    logical count: the old vuid's chunk is destroyed after the re-home."""
+    from chubaofs_tpu.blobstore.blobnode import BlobNode, NoSuchShard
+
+    c = MiniCluster(str(tmp_path), n_nodes=6, disks_per_node=2)
+    try:
+        loc = c.access.put(blob_bytes(rng, 500_000))
+        node = BlobNode(node_id=55, disk_roots=[str(tmp_path / "n55" / "d0")])
+        c.nodes[55] = node
+        for disk_id in node.disks:
+            c.cm.register_disk(disk_id, node_id=55, az=0)
+        task = c.scheduler.check_balance(min_gap=1)
+        assert task is not None
+        vol = c.cm.get_volume(task.vid)
+        old_unit = next(u for u in vol.units if u.disk_id == task.disk_id)
+        old_vuid, old_node = old_unit.vuid, old_unit.node_id
+        while c.worker.run_once():
+            pass
+        # pinned destination honored, old chunk physically gone
+        new_unit = c.cm.get_volume(task.vid).units[old_unit.index]
+        assert new_unit.disk_id == task.dest_disk_id
+        with pytest.raises(NoSuchShard):
+            c.nodes[old_node].get_shard(old_vuid, loc.blobs[0].bid)
+        assert len(c.access.get(loc)) == 500_000
+    finally:
+        c.close()

@@ -416,3 +416,95 @@ def test_malformed_presigned_params_403_not_500(s3env):
            "&X-Amz-Signature=deadbeef")
     status, body = raw_req(s3, "GET", "/psbkt/obj?" + bad)
     assert status == 403
+
+
+# -- action breadth: attributes, policy status, canned ACLs, directives --------
+
+
+def test_get_object_attributes(s3env):
+    s3, _ = s3env
+    req(s3, "PUT", "/attrbkt")
+    req(s3, "PUT", "/attrbkt/k", body=b"x" * 1234)
+    status, h, body = req(s3, "GET", "/attrbkt/k", raw_query="attributes",
+                          headers={"x-amz-object-attributes":
+                                   "ETag,ObjectSize,StorageClass"})
+    assert status == 200
+    root = xml_of(body)
+    assert root.findtext("ObjectSize") == "1234"
+    assert root.findtext("StorageClass") == "STANDARD"
+    assert root.findtext("ETag")
+    assert "Last-Modified" in h
+
+
+def test_bucket_policy_status(s3env):
+    s3, _ = s3env
+    req(s3, "PUT", "/polbkt")
+    status, _, body = req(s3, "GET", "/polbkt", raw_query="policyStatus")
+    assert status == 200 and b"<IsPublic>false</IsPublic>" in body
+    policy = (b'{"Statement": [{"Effect": "Allow", "Principal": "*",'
+              b' "Action": ["s3:GetObject"], "Resource": ["polbkt/*"]}]}')
+    assert req(s3, "PUT", "/polbkt", body=policy, raw_query="policy")[0] in (200, 204)
+    _, _, body = req(s3, "GET", "/polbkt", raw_query="policyStatus")
+    assert b"<IsPublic>true</IsPublic>" in body
+
+
+def test_copy_metadata_directive_replace(s3env):
+    s3, _ = s3env
+    req(s3, "PUT", "/mdbkt")
+    req(s3, "PUT", "/mdbkt/src", body=b"data",
+        headers={"x-amz-meta-color": "red", "content-type": "text/plain"})
+    # COPY (default): source metadata travels
+    req(s3, "PUT", "/mdbkt/c1", headers={"x-amz-copy-source": "/mdbkt/src"})
+    _, h, _ = req(s3, "HEAD", "/mdbkt/c1")
+    assert h.get("x-amz-meta-color") == "red"
+    # REPLACE: request metadata wins
+    req(s3, "PUT", "/mdbkt/c2",
+        headers={"x-amz-copy-source": "/mdbkt/src",
+                 "x-amz-metadata-directive": "REPLACE",
+                 "x-amz-meta-color": "blue", "content-type": "text/csv"})
+    _, h, _ = req(s3, "HEAD", "/mdbkt/c2")
+    assert h.get("x-amz-meta-color") == "blue"
+    assert h.get("Content-Type") == "text/csv"
+
+
+def test_put_object_canned_acl(s3env):
+    s3, _ = s3env
+    req(s3, "PUT", "/aclbkt")
+    req(s3, "PUT", "/aclbkt/pub", body=b"open",
+        headers={"x-amz-acl": "public-read"})
+    status, _, body = req(s3, "GET", "/aclbkt/pub", raw_query="acl")
+    assert status == 200 and b"<Grantee>*</Grantee>" in body
+    status, _, body = req(s3, "PUT", "/aclbkt/bad", body=b"x",
+                          headers={"x-amz-acl": "nonsense"})
+    assert status == 400
+
+
+def test_batch_delete_quiet_mode(s3env):
+    s3, _ = s3env
+    req(s3, "PUT", "/qbkt")
+    req(s3, "PUT", "/qbkt/a", body=b"1")
+    dele = (b"<Delete><Quiet>true</Quiet>"
+            b"<Object><Key>a</Key></Object></Delete>")
+    status, _, body = req(s3, "POST", "/qbkt", body=dele, raw_query="delete")
+    assert status == 200 and b"<Deleted>" not in body
+    assert req(s3, "GET", "/qbkt/a")[0] == 404
+
+
+def test_invalid_canned_acl_writes_nothing(s3env):
+    s3, _ = s3env
+    req(s3, "PUT", "/aclbkt2")
+    status, _, _ = req(s3, "PUT", "/aclbkt2/k", body=b"x",
+                       headers={"x-amz-acl": "nonsense"})
+    assert status == 400
+    assert req(s3, "GET", "/aclbkt2/k")[0] == 404  # nothing was written
+
+
+def test_copy_applies_canned_acl(s3env):
+    s3, _ = s3env
+    req(s3, "PUT", "/aclbkt3")
+    req(s3, "PUT", "/aclbkt3/src", body=b"data")
+    req(s3, "PUT", "/aclbkt3/dst",
+        headers={"x-amz-copy-source": "/aclbkt3/src",
+                 "x-amz-acl": "public-read"})
+    status, _, body = req(s3, "GET", "/aclbkt3/dst", raw_query="acl")
+    assert status == 200 and b"<Grantee>*</Grantee>" in body
