@@ -469,6 +469,19 @@ class BlobNode:
         """Simulate media loss of one shard (no delete tombstone)."""
         self._chunk(vuid).lose(bid)
 
+    def tombstone_shard(self, vuid: int, bid: int) -> None:
+        """Record delete intent for a bid this chunk never stored — migrations
+        carry tombstones WITH the unit, or a partially-deleted blob would be
+        resurrected once the only tombstone-holding chunk moves."""
+        chunk = self._chunk(vuid)
+        with chunk._lock:
+            if bid in chunk.shards:
+                return  # live here: a real delete must go through delete()
+            meta = ShardMeta(bid=bid, vuid=vuid, offset=0, size=0,
+                             status=STATUS_DELETED)
+            chunk._log_idx(meta)
+            chunk.tombstones.add(bid)
+
     def drop_vuid(self, vuid: int) -> None:
         """Release a re-homed volume unit's chunk: the space a balance/migrate
         moved away must actually free on the source disk. Idempotent."""

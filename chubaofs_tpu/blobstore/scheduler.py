@@ -61,7 +61,7 @@ class Task:
     bid: int = 0
     bad_idx: list[int] = field(default_factory=list)
     disk_id: int = 0
-    dest_disk_id: int = 0
+    dest_disk_id: int | None = None  # None = pick at execution
     created: float = field(default_factory=time.time)
     retries: int = 0
     error: str = ""
@@ -231,6 +231,10 @@ class Scheduler:
                 if bad:
                     self.proxy.send_shard_repair(vid, bid, bad, "inspect")
                     produced += 1
+        if produced:
+            from chubaofs_tpu.utils.exporter import default_registry
+
+            default_registry().counter("scheduler_inspect_findings").add(produced)
         return produced
 
     def drop_disk(self, disk_id: int) -> Task:
@@ -272,6 +276,9 @@ class Scheduler:
                 # the source would just ping-pong units back and forth
                 if self.cm.disks[dest].chunk_count + min_gap > src.chunk_count:
                     continue
+                from chubaofs_tpu.utils.exporter import default_registry
+
+                default_registry().counter("scheduler_balance_tasks").add()
                 return self._new_task(kind=KIND_BALANCE, vid=vol.vid,
                                       disk_id=src.disk_id,
                                       dest_disk_id=dest)
@@ -520,7 +527,7 @@ class RepairWorker:
             return
         source_broken = self.cm.disks[task.disk_id].status != DISK_NORMAL
         self._migrate_unit(vol, unit, task.disk_id, source_broken,
-                           dest_disk_id=task.dest_disk_id or None)
+                           dest_disk_id=task.dest_disk_id)
 
     def _enqueue_missing(self, vol: VolumeInfo):
         """Probe every stripe position of every bid in the volume; feed any
@@ -561,10 +568,21 @@ class RepairWorker:
             except Exception:
                 continue
         # phase 1: source copies or reconstruct futures (submitted together so
-        # the codec service batches them into shared device calls)
+        # the codec service batches them into shared device calls). Tombstones
+        # TRAVEL with the unit: a bid deleted at the source must stay deleted
+        # at the destination, never be resurrected from the other units.
+        src_node = self.nodes.get(unit.node_id)
         rows: dict[int, bytes] = {}
         futures: dict[int, object] = {}
+        tombstoned: list[int] = []
         for bid in sorted(bids):
+            if src_node is not None:
+                try:
+                    if src_node.has_tombstone(unit.vuid, bid):
+                        tombstoned.append(bid)
+                        continue
+                except Exception:
+                    pass
             if not source_broken:
                 try:
                     node = self.nodes[unit.node_id]
@@ -613,6 +631,8 @@ class RepairWorker:
         dest_node.create_vuid(new_unit.vuid, new_unit.disk_id)
         for bid, payload in rows.items():
             dest_node.put_shard(new_unit.vuid, bid, payload)
+        for bid in tombstoned:
+            dest_node.tombstone_shard(new_unit.vuid, bid)
         # the move must FREE the source: drop the superseded chunk (best
         # effort — an unreachable/broken source just leaks until re-imaged)
         old_node = self.nodes.get(old_node_id)

@@ -619,18 +619,19 @@ class ObjectNode:
         bucket = req.params["bucket"]
         self._check(req, bucket, ACTION_GET, perm="READ_ACP")
         raw = self._vol(bucket).get_bucket_xattr(XATTR_POLICY)
-        public = False
-        if raw:
-            # same matcher the request path uses: IsPublic must never diverge
-            # from actual anonymous evaluation
-            pol = Policy.from_json(raw)
-            statements = pol.doc["Statement"]
-            if isinstance(statements, dict):
-                statements = [statements]
-            public = any(
-                st.get("Effect") == ALLOW
-                and Policy._principal_matches(st, None)
-                for st in statements)
+        if not raw:
+            # S3 distinguishes "no policy" (404) from "policy, not public"
+            raise S3Error(404, "NoSuchBucketPolicy", bucket)
+        # same matcher the request path uses: IsPublic must never diverge
+        # from actual anonymous evaluation
+        pol = Policy.from_json(raw)
+        statements = pol.doc["Statement"]
+        if isinstance(statements, dict):
+            statements = [statements]
+        public = any(
+            st.get("Effect") == ALLOW
+            and Policy._principal_matches(st, None)
+            for st in statements)
         return Response.xml(
             f"<PolicyStatus><IsPublic>{str(public).lower()}</IsPublic>"
             f"</PolicyStatus>")

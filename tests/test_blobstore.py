@@ -520,3 +520,36 @@ def test_balance_frees_source_chunk(tmp_path, rng):
         assert len(c.access.get(loc)) == 500_000
     finally:
         c.close()
+
+
+def test_migration_carries_tombstones(tmp_path, rng):
+    """A unit move must not resurrect a bid whose delete tombstone lived only
+    on the moved unit: the tombstone travels with it."""
+    from chubaofs_tpu.blobstore.blobnode import BlobNode
+
+    c = MiniCluster(str(tmp_path), n_nodes=6, disks_per_node=2)
+    try:
+        loc = c.access.put(blob_bytes(rng, 500_000))
+        vid, bid = loc.blobs[0].vid, loc.blobs[0].bid
+        vol = c.cm.get_volume(vid)
+        node = BlobNode(node_id=66, disk_roots=[str(tmp_path / "n66" / "d0")])
+        c.nodes[66] = node
+        for disk_id in node.disks:
+            c.cm.register_disk(disk_id, node_id=66, az=0)
+        task = c.scheduler.check_balance(min_gap=1)
+        assert task is not None and task.vid == vid
+        unit = next(u for u in vol.units if u.disk_id == task.disk_id)
+        # delete applied ONLY at the about-to-move unit (others unreachable)
+        c.nodes[unit.node_id].mark_delete_shard(unit.vuid, bid)
+        c.nodes[unit.node_id].delete_shard(unit.vuid, bid)
+        while c.worker.run_once():
+            pass
+        new_unit = c.cm.get_volume(vid).units[unit.index]
+        new_node = c.nodes[new_unit.node_id]
+        # the bid was NOT resurrected at the destination, and the tombstone
+        # survived the move for the inspector's partial-delete protocol
+        with pytest.raises(Exception):
+            new_node.get_shard(new_unit.vuid, bid)
+        assert new_node.has_tombstone(new_unit.vuid, bid)
+    finally:
+        c.close()

@@ -439,6 +439,13 @@ def test_get_object_attributes(s3env):
 def test_bucket_policy_status(s3env):
     s3, _ = s3env
     req(s3, "PUT", "/polbkt")
+    # no policy -> 404 NoSuchBucketPolicy (S3 distinguishes this from private)
+    status, _, body = req(s3, "GET", "/polbkt", raw_query="policyStatus")
+    assert status == 404 and b"NoSuchBucketPolicy" in body
+    private = (b'{"Statement": [{"Effect": "Allow", "Principal": {"AWS": "me"},'
+               b' "Action": ["s3:GetObject"], "Resource": ["polbkt/*"]}]}')
+    assert req(s3, "PUT", "/polbkt", body=private,
+               raw_query="policy")[0] in (200, 204)
     status, _, body = req(s3, "GET", "/polbkt", raw_query="policyStatus")
     assert status == 200 and b"<IsPublic>false</IsPublic>" in body
     policy = (b'{"Statement": [{"Effect": "Allow", "Principal": "*",'
