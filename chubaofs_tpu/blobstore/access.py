@@ -36,6 +36,7 @@ from chubaofs_tpu.blobstore.clustermgr import ClusterMgr, VolumeInfo
 from chubaofs_tpu.blobstore.proxy import Proxy
 from chubaofs_tpu.codec.codemode import CodeMode, get_tactic
 from chubaofs_tpu.codec.service import CodecService, default_service
+from chubaofs_tpu.utils.exporter import default_registry
 
 MAX_BLOB_SIZE = 4 * 1024 * 1024
 
@@ -194,8 +195,6 @@ class Access:
             return self._punished.get(disk_id, 0.0) > time.monotonic()
 
     def punish_disk(self, disk_id: int, reason: str = "") -> None:
-        from chubaofs_tpu.utils.exporter import default_registry
-
         with self._punish_lock:
             self._punished[disk_id] = time.monotonic() + self.punish_secs
         default_registry().counter(
@@ -219,8 +218,6 @@ class Access:
         from chubaofs_tpu.blobstore import trace
 
         if self.qos is not None and not self.qos.wait("put", len(data), timeout=self.qos_timeout):
-            from chubaofs_tpu.utils.exporter import default_registry
-
             default_registry().counter("access_qos_reject", {"op": "put"}).add()
             raise AccessError("put bandwidth limit exceeded")
         with trace.child_of(trace.current_span(), "access.put") as span:
@@ -368,8 +365,6 @@ class Access:
             # charge the real read size: a default full-object get is loc.size
             want = size if size is not None else max(0, loc.size - offset)
             if not self.qos.wait("get", max(1, want), timeout=self.qos_timeout):
-                from chubaofs_tpu.utils.exporter import default_registry
-
                 default_registry().counter("access_qos_reject", {"op": "get"}).add()
                 raise AccessError("get bandwidth limit exceeded")
         with trace.child_of(trace.current_span(), "access.get") as span:

@@ -302,6 +302,17 @@ class Chunk:
                 os.unlink(old_path)
             return old_size - self._size
 
+    def tombstone(self, bid: int):
+        """Record delete intent for a bid this chunk never stored (migrations
+        carry tombstones with the unit). No-op when the bid is live here."""
+        with self._lock:
+            if bid in self.shards:
+                return  # live here: a real delete must go through delete()
+            meta = ShardMeta(bid=bid, vuid=0, offset=0, size=0,
+                             status=STATUS_DELETED)
+            self._log_idx(meta)
+            self.tombstones.add(bid)
+
     def lose(self, bid: int):
         """Drop a record WITHOUT a tombstone — models media loss (a lost
         sector/file), as opposed to delete(), which records intent. The
@@ -473,14 +484,11 @@ class BlobNode:
         """Record delete intent for a bid this chunk never stored — migrations
         carry tombstones WITH the unit, or a partially-deleted blob would be
         resurrected once the only tombstone-holding chunk moves."""
-        chunk = self._chunk(vuid)
-        with chunk._lock:
-            if bid in chunk.shards:
-                return  # live here: a real delete must go through delete()
-            meta = ShardMeta(bid=bid, vuid=vuid, offset=0, size=0,
-                             status=STATUS_DELETED)
-            chunk._log_idx(meta)
-            chunk.tombstones.add(bid)
+        self._chunk(vuid).tombstone(bid)
+
+    def tombstones_of(self, vuid: int) -> set[int]:
+        """All tombstoned bids of one unit (migrations enumerate these)."""
+        return set(self._chunk(vuid).tombstones)
 
     def drop_vuid(self, vuid: int) -> None:
         """Release a re-homed volume unit's chunk: the space a balance/migrate

@@ -37,6 +37,7 @@ from chubaofs_tpu.blobstore.proxy import (
 )
 from chubaofs_tpu.codec.codemode import get_tactic
 from chubaofs_tpu.codec.service import CodecService, default_service
+from chubaofs_tpu.utils.exporter import default_registry
 
 TASK_PREPARED = "prepared"
 TASK_WORKING = "working"
@@ -232,8 +233,6 @@ class Scheduler:
                     self.proxy.send_shard_repair(vid, bid, bad, "inspect")
                     produced += 1
         if produced:
-            from chubaofs_tpu.utils.exporter import default_registry
-
             default_registry().counter("scheduler_inspect_findings").add(produced)
         return produced
 
@@ -276,8 +275,6 @@ class Scheduler:
                 # the source would just ping-pong units back and forth
                 if self.cm.disks[dest].chunk_count + min_gap > src.chunk_count:
                     continue
-                from chubaofs_tpu.utils.exporter import default_registry
-
                 default_registry().counter("scheduler_balance_tasks").add()
                 return self._new_task(kind=KIND_BALANCE, vid=vol.vid,
                                       disk_id=src.disk_id,
@@ -569,20 +566,22 @@ class RepairWorker:
                 continue
         # phase 1: source copies or reconstruct futures (submitted together so
         # the codec service batches them into shared device calls). Tombstones
-        # TRAVEL with the unit: a bid deleted at the source must stay deleted
-        # at the destination, never be resurrected from the other units.
+        # TRAVEL with the unit — enumerated DIRECTLY from the source chunk
+        # (they are invisible to list_shards, so deriving them from live bids
+        # would drop any delete whose bid no reachable unit still serves) —
+        # a bid deleted at the source must stay deleted at the destination.
         src_node = self.nodes.get(unit.node_id)
+        tombstoned: set[int] = set()
+        if src_node is not None:
+            try:
+                tombstoned = src_node.tombstones_of(unit.vuid)
+            except Exception:
+                pass
         rows: dict[int, bytes] = {}
         futures: dict[int, object] = {}
-        tombstoned: list[int] = []
         for bid in sorted(bids):
-            if src_node is not None:
-                try:
-                    if src_node.has_tombstone(unit.vuid, bid):
-                        tombstoned.append(bid)
-                        continue
-                except Exception:
-                    pass
+            if bid in tombstoned:
+                continue
             if not source_broken:
                 try:
                     node = self.nodes[unit.node_id]

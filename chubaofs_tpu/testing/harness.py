@@ -30,16 +30,27 @@ def free_port() -> int:
 class ProcCluster:
     """A full cluster of daemon subprocesses."""
 
-    def __init__(self, root: str, masters: int = 3, metanodes: int = 3,
-                 datanodes: int = 3, blobstore: bool = False,
-                 objectnode: bool = False, env: dict | None = None):
+    @classmethod
+    def shell(cls, root: str, env: dict | None = None) -> "ProcCluster":
+        """An empty harness (spawn/await/close machinery, no daemons) for
+        tests that compose their own role mix."""
+        self = cls.__new__(cls)
         self.root = root
         os.makedirs(root, exist_ok=True)
         self.env = dict(os.environ)
         self.env["PYTHONPATH"] = REPO + os.pathsep + self.env.get("PYTHONPATH", "")
         self.env.setdefault("JAX_PLATFORMS", "cpu")
         self.env.update(env or {})
-        self.procs: dict[str, subprocess.Popen] = {}
+        self.procs = {}
+        return self
+
+    def __init__(self, root: str, masters: int = 3, metanodes: int = 3,
+                 datanodes: int = 3, blobstore: bool = False,
+                 objectnode: bool = False, env: dict | None = None):
+        shell = ProcCluster.shell(root, env)
+        self.root = shell.root
+        self.env = shell.env
+        self.procs: dict[str, subprocess.Popen] = shell.procs
 
         # masters need static raft + api ports so peers can dial each other
         raft_ports = {i: free_port() for i in range(1, masters + 1)}

@@ -278,3 +278,102 @@ def test_metanode_decommission_over_api(cluster):
     else:
         raise AssertionError("namespace unreadable after decommission")
     cluster.fs("drain").write_file("/post-drain.txt", b"still writable")
+
+
+def test_ticket_gated_cluster_over_daemons(tmp_path):
+    """Full security composition in daemon mode: an authnode daemon mints the
+    master's service key + per-role client credentials; the master enforces
+    per-route capabilities; metanodes/datanodes register and heartbeat with
+    node-capability credentials (renewing providers); an operator client with
+    master:admin creates a volume; an uncredentialed client is denied."""
+    import base64
+
+    from chubaofs_tpu.master.api_service import MasterClient, MasterError
+    from chubaofs_tpu.rpc.client import RPCClient
+    from chubaofs_tpu.testing.harness import ProcCluster, free_port
+
+    root = str(tmp_path / "tg")
+
+    # 1. authnode daemon
+    auth_port = free_port()
+    auth_addr = f"127.0.0.1:{auth_port}"
+    shell = ProcCluster.shell(root)  # spawn machinery, own role mix
+    shell.spawn("authnode", {
+        "role": "authnode", "id": 1, "raftPeers": {"1": "127.0.0.1:0"},
+        "listen": auth_addr, "walDir": root + "/an",
+        "adminSecret": "adm1n"})
+    shell._await_listen(auth_addr)
+
+    admin_rpc = RPCClient([auth_addr], auth_secret=b"adm1n")
+    deadline = time.time() + 15
+    while True:  # single-node raft leader election
+        try:
+            svc = admin_rpc.post("/admin/createkey",
+                                 {"id": "master", "role": "service"})
+            break
+        except Exception:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.3)
+    node_cred = admin_rpc.post("/admin/createkey", {
+        "id": "nodes", "role": "client", "caps": ["master:node"]})
+    op_cred = admin_rpc.post("/admin/createkey", {
+        "id": "operator", "role": "client", "caps": ["master:admin"]})
+
+    # 2. gated master + credentialed metanodes/datanodes
+    api_port = free_port()
+    master_addr = f"127.0.0.1:{api_port}"
+    node_auth = {"authAddrs": [auth_addr], "authClientId": "nodes",
+                 "authClientKey": node_cred["key"]}
+    shell.spawn("master", {
+        "role": "master", "id": 1, "raftPeers": {"1": "127.0.0.1:0"},
+        "listen": master_addr, "walDir": root + "/m1",
+        "adminTicketKey": svc["key"]})
+    shell._await_listen(master_addr)
+    for i in (2, 3, 4):
+        shell.spawn(f"mn{i}", {"role": "metanode", "id": i,
+                               "masterAddrs": [master_addr],
+                               "walDir": f"{root}/mn{i}", **node_auth})
+    for j in (1, 2, 3):
+        shell.spawn(f"dn{j}", {"role": "datanode", "id": 100 + j,
+                               "masterAddrs": [master_addr],
+                               "disks": [f"{root}/dn{j}/d0"],
+                               "walDir": f"{root}/dn{j}/wal", **node_auth})
+    try:
+        # nodes registered + heartbeat through their node-capability tickets
+        viewer = MasterClient([master_addr])  # reads stay open
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                if sum(1 for n in viewer.get_cluster()["nodes"]
+                       if n["addr"]) >= 6:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.3)
+        else:
+            raise AssertionError("credentialed nodes never registered")
+
+        # no credential -> denied on admin mutations
+        with pytest.raises(MasterError, match="ticket"):
+            viewer.create_volume("deny-me")
+
+        # the operator's renewing provider passes
+        from chubaofs_tpu.authnode.api import RemoteAuthNode
+        from chubaofs_tpu.authnode.server import AuthClient, RenewingTicket
+
+        prov = RenewingTicket(
+            AuthClient(RemoteAuthNode([auth_addr]), "operator",
+                       base64.b64decode(op_cred["key"])), "master")
+        op = MasterClient([master_addr], admin_ticket=prov)
+        vol = op.create_volume("tgvol")
+        assert vol["name"] == "tgvol"
+        # node credentials can't do admin mutations (least privilege)
+        node_prov = RenewingTicket(
+            AuthClient(RemoteAuthNode([auth_addr]), "nodes",
+                       base64.b64decode(node_cred["key"])), "master")
+        with pytest.raises(MasterError, match="ticket"):
+            MasterClient([master_addr],
+                         admin_ticket=node_prov).delete_volume("tgvol")
+    finally:
+        shell.close()

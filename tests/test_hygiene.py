@@ -277,3 +277,23 @@ def test_tombstones_survive_compaction(tmp_path, rng):
     assert node2.has_tombstone(6, 1)
     assert not node2.has_tombstone(6, 2)
     node2.close()
+
+
+def test_tombstones_of_enumeration(tmp_path, rng):
+    """tombstones_of lists delete intent directly (including bids never stored
+    here) — migrations must carry them even when no live copy exists."""
+    node = BlobNode(node_id=1, disk_roots=[str(tmp_path / "d0")])
+    node.create_vuid(12)
+    node.put_shard(12, 1, blob_bytes(rng, 512))
+    node.mark_delete_shard(12, 1)
+    node.delete_shard(12, 1)
+    node.put_shard(12, 2, blob_bytes(rng, 512))
+    assert node.tombstones_of(12) == {1}
+    node.tombstone_shard(12, 9)  # carried from elsewhere, never stored here
+    assert node.tombstones_of(12) == {1, 9}
+    node.tombstone_shard(12, 2)  # live bid: must NOT become a tombstone
+    assert node.tombstones_of(12) == {1, 9}
+    node.close()
+    node2 = BlobNode(node_id=1, disk_roots=[str(tmp_path / "d0")])
+    assert node2.tombstones_of(12) == {1, 9}  # persisted
+    node2.close()
