@@ -179,6 +179,11 @@ class Access:
         self._punished: dict[int, float] = {}
         self._punish_lock = threading.Lock()
         self._pool = ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="access")
+        # reads NEVER share the write pool: stripe writes can legitimately
+        # hold slots up to write_deadline (wedged-disk containment), and a GET
+        # queued behind them would trade its millisecond latency for seconds
+        self._read_pool = ThreadPoolExecutor(max_workers=max_workers,
+                                             thread_name_prefix="access-read")
 
     # -- failure containment --------------------------------------------------
 
@@ -399,20 +404,23 @@ class Access:
         shard_len = t.shard_size(blob.size)
 
         # fast path: ranged sub-shard reads of only the data shards the byte
-        # range touches (blobnode serves CRC-framed sub-ranges natively)
+        # range touches (blobnode serves CRC-framed sub-ranges natively),
+        # issued CONCURRENTLY — a full-stripe GET pays one shard's latency,
+        # not N of them (stream_get.go fans reads out the same way)
         first_shard = offset // shard_len
         last_shard = (offset + size - 1) // shard_len
-        pieces: list[bytes] = []
-        degraded = False
-        for idx in range(first_shard, last_shard + 1):
+
+        def read_one(idx: int):
             lo = max(offset, idx * shard_len) - idx * shard_len
             hi = min(offset + size, (idx + 1) * shard_len) - idx * shard_len
-            piece = self._read_shard(vol, idx, blob.bid, lo, hi - lo)
-            if piece is None:
-                degraded = True
-                break
-            pieces.append(piece)
-        if not degraded:
+            return self._read_shard(vol, idx, blob.bid, lo, hi - lo)
+
+        idxs = range(first_shard, last_shard + 1)
+        if len(idxs) == 1:
+            pieces = [read_one(first_shard)]
+        else:
+            pieces = list(self._read_pool.map(read_one, idxs))
+        if all(p is not None for p in pieces):
             return b"".join(pieces)
         return self._read_blob_degraded(t, vol, blob, shard_len, offset, size)
 
@@ -436,8 +444,10 @@ class Access:
         (stream_get.go:427 ReconstructData fallback)."""
         stripe = np.zeros((t.N + t.M, shard_len), np.uint8)
         present = []
-        for idx in range(t.N + t.M):
-            data = self._read_shard(vol, idx, blob.bid, 0, shard_len)
+        reads = list(self._read_pool.map(
+            lambda idx: self._read_shard(vol, idx, blob.bid, 0, shard_len),
+            range(t.N + t.M)))
+        for idx, data in enumerate(reads):
             if data is not None:
                 stripe[idx] = np.frombuffer(data, np.uint8)
                 present.append(idx)

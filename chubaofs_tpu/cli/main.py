@@ -75,8 +75,27 @@ class CLI:
         print(f"Volumes    : {len(c['volumes'])}", file=self.out)
         print(f"Users      : {len(c['users'])}", file=self.out)
         rows = [{"id": n["node_id"], "kind": n["kind"], "addr": n["addr"],
+                 "zone": n.get("zone", ""), "status": n.get("status", ""),
                  "partitions": n["partition_count"]} for n in c["nodes"]]
-        table(rows, ["id", "kind", "addr", "partitions"], self.out)
+        table(rows, ["id", "kind", "addr", "zone", "status", "partitions"],
+              self.out)
+
+    def cluster_topology(self, args):
+        """Zones -> nodesets -> nodes, rendered from the master's own
+        topology view (`cfs-cli zone list` analog)."""
+        topo = self.mc.get_topology()
+        if self.as_json:
+            return self._emit(topo)
+        by_id = {n["node_id"]: n for n in self.mc.get_cluster()["nodes"]}
+        rows = []
+        for zone in sorted(topo):
+            for ns in sorted(topo[zone], key=int):
+                for nid in topo[zone][ns]:
+                    n = by_id.get(nid, {})
+                    rows.append({"zone": zone or "(none)", "nodeset": ns,
+                                 "id": nid, "kind": n.get("kind", "?"),
+                                 "status": n.get("status", "")})
+        table(rows, ["zone", "nodeset", "id", "kind", "status"], self.out)
 
     # -- volumes ---------------------------------------------------------------
 
@@ -175,7 +194,7 @@ _cfs_cli() {
   prev="${COMP_WORDS[COMP_CWORD-1]}"
   nouns="cluster vol metanode datanode metapartition datapartition user config completion"
   case "$prev" in
-    cluster) verbs="info" ;;
+    cluster) verbs="info topology" ;;
     vol) verbs="create list info delete" ;;
     metanode|datanode) verbs="list decommission" ;;
     metapartition) verbs="list" ;;
@@ -204,6 +223,7 @@ def build_parser() -> argparse.ArgumentParser:
 
     cluster = sub.add_parser("cluster").add_subparsers(dest="verb", required=True)
     cluster.add_parser("info").set_defaults(fn="cluster_info")
+    cluster.add_parser("topology").set_defaults(fn="cluster_topology")
 
     vol = sub.add_parser("vol", aliases=["volume"]).add_subparsers(
         dest="verb", required=True)

@@ -62,6 +62,7 @@ class MasterAPI:
         r = Router()
         g = r.get
         g("/admin/getCluster", self._w(self.get_cluster, leader=False))
+        g("/admin/getTopology", self._w(self.get_topology, leader=False))
         g("/admin/getIp", self._w(self.get_ip, leader=False))
         g("/admin/createVol", self._w(self.create_vol, admin=True))
         g("/admin/deleteVol", self._w(self.delete_vol, admin=True))
@@ -135,6 +136,12 @@ class MasterAPI:
             "volumes": sorted(sm.volumes),
             "users": sorted(sm.users),
         }
+
+    def get_topology(self, req: Request):
+        """zones -> nodesets -> node ids (master/topology.go view); the ONE
+        grouping implementation (Master.topology), never re-derived by clients."""
+        return {zone: {str(ns): ids for ns, ids in sets.items()}
+                for zone, sets in self.master.topology().items()}
 
     def get_ip(self, req: Request):
         return {"cluster": "chubaofs-tpu", "ip": req.remote}
@@ -353,6 +360,9 @@ class MasterClient:
 
     def get_cluster(self):
         return self.call("/admin/getCluster")
+
+    def get_topology(self):
+        return self.call("/admin/getTopology")
 
     def create_volume(self, name: str, owner: str = "", cold: bool = False,
                       capacity: int = 1 << 40, dp_count: int = 3):

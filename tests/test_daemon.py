@@ -275,6 +275,9 @@ def test_cli_against_daemon_cluster(cluster, capsys):
     out = run("cluster", "info")
     assert "Leader" in out and "meta" in out
 
+    out = run("cluster", "topology")
+    assert "ZONE" in out and "NODESET" in out
+
     run("vol", "create", "clivol", "--dp-count", "3")
     out = run("vol", "list")
     assert "clivol" in out
