@@ -355,6 +355,12 @@ class ClusterMgr:
     def _op_set_config(self, key: str, value: str):
         self.config[key] = value
 
+    def del_config(self, key: str) -> None:
+        self.apply("del_config", {"key": key})
+
+    def _op_del_config(self, key: str):
+        self.config.pop(key, None)
+
     def get_config(self, key: str, default: str | None = None) -> str | None:
         with self._lock:
             return self.config.get(key, default)

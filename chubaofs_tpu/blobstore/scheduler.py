@@ -17,6 +17,7 @@ via HTTPTaskAcquire, service.go:84, repair tasks served first). Shapes kept:
 
 from __future__ import annotations
 
+import json
 import threading
 import time
 from dataclasses import dataclass, field
@@ -89,14 +90,44 @@ class Scheduler:
         self._tasks: dict[str, Task] = {}
         self._seq = 0
         self._inspect_cursor = 0  # round-robin position over volume ids
+        self._load_tasks()
 
-    # -- task table ----------------------------------------------------------
+    # -- task table (persisted in the clustermgr config KV, the reference's
+    # migrate-task tables in clustermgr: migrate.go:346-347) -------------------
+
+    _TASK_PREFIX = "task/"
+    _TASK_SEQ_KEY = "task_seq"
+
+    def _load_tasks(self):
+        """Reload open tasks after a restart; WORKING tasks re-queue (their
+        worker died with us — the reference's junk-task cleanup re-drives).
+        The id counter persists separately so completed tasks' ids are never
+        reissued (the recordlog audit keys on them)."""
+        self._seq = int(self.cm.get_config(self._TASK_SEQ_KEY) or 0)
+        for key, raw in list(self.cm.config.items()):
+            if not key.startswith(self._TASK_PREFIX) or not raw:
+                continue
+            t = Task(**json.loads(raw))
+            if t.state == TASK_WORKING:
+                t.state = TASK_PREPARED
+            self._tasks[t.task_id] = t
+
+    def _persist_task(self, t: Task):
+        key = self._TASK_PREFIX + t.task_id
+        if t.state in (TASK_FINISHED, TASK_FAILED):
+            # terminal states LEAVE the table (the recordlog keeps the audit);
+            # a real delete, so the config KV never grows with task history
+            self.cm.del_config(key)
+            return
+        self.cm.set_config(key, json.dumps(t.__dict__))
 
     def _new_task(self, **kw) -> Task:
         with self._lock:
             self._seq += 1
+            self.cm.set_config(self._TASK_SEQ_KEY, str(self._seq))
             t = Task(task_id=f"t{self._seq}", **kw)
             self._tasks[t.task_id] = t
+            self._persist_task(t)
             return t
 
     def tasks(self, kind: str | None = None, state: str | None = None) -> list[Task]:
@@ -300,6 +331,8 @@ class Scheduler:
             for kind in _PRIORITY:
                 for t in self._tasks.values():
                     if t.kind == kind and t.state == TASK_PREPARED:
+                        # WORKING is NOT persisted: reload demotes it back to
+                        # PREPARED anyway, so the write would buy nothing
                         t.state = TASK_WORKING
                         return t
         return None
@@ -313,6 +346,7 @@ class Scheduler:
                 t.retries += 1
                 t.error = error
                 t.state = TASK_PREPARED if t.retries < 3 else TASK_FAILED
+            self._persist_task(t)
             record = None
             if self.record_log is not None and t.state in (TASK_FINISHED, TASK_FAILED):
                 record = {

@@ -553,3 +553,70 @@ def test_migration_carries_tombstones(tmp_path, rng):
         assert new_node.has_tombstone(new_unit.vuid, bid)
     finally:
         c.close()
+
+
+def test_scheduler_tasks_survive_restart(tmp_path, rng):
+    """Open tasks persist in the clustermgr KV and reload on a scheduler
+    restart; in-flight (WORKING) tasks re-queue (migrate.go:346-347 analog)."""
+    from chubaofs_tpu.blobstore.scheduler import (
+        KIND_SHARD_REPAIR, TASK_FINISHED, TASK_PREPARED, Scheduler)
+
+    c = MiniCluster(str(tmp_path), n_nodes=9, disks_per_node=2)
+    try:
+        data = blob_bytes(rng, 2_000_000)
+        loc = c.access.put(data, code_mode=CodeMode.EC12P4)
+        blob = loc.blobs[0]
+        vol = c.cm.get_volume(blob.vid)
+        unit = vol.units[2]
+        c.nodes[unit.node_id].lose_shard(unit.vuid, blob.bid)
+        c.proxy.send_shard_repair(vol.vid, blob.bid, [2], "test")
+        c.scheduler.poll_repair_topic()
+        task = c.scheduler.acquire_task()  # WORKING, then the "worker dies"
+        assert task is not None
+
+        sched2 = Scheduler(c.cm, c.proxy, c.nodes, codec=c.codec)
+        reloaded = {t.task_id: t for t in sched2.tasks(KIND_SHARD_REPAIR)}
+        assert task.task_id in reloaded
+        assert reloaded[task.task_id].state == TASK_PREPARED  # re-queued
+
+        # the restarted scheduler's worker completes the repair
+        from chubaofs_tpu.blobstore.scheduler import RepairWorker
+
+        w2 = RepairWorker(sched2, c.nodes, codec=c.codec)
+        while w2.run_once():
+            pass
+        assert sched2.tasks(KIND_SHARD_REPAIR)[0].state == TASK_FINISHED
+        assert len(c.nodes[unit.node_id].get_shard(unit.vuid, blob.bid)) > 0
+
+        # terminal tasks leave the persisted table: a third scheduler is empty
+        sched3 = Scheduler(c.cm, c.proxy, c.nodes, codec=c.codec)
+        assert sched3.tasks(KIND_SHARD_REPAIR) == []
+    finally:
+        c.close()
+
+
+def test_task_ids_never_reissued_after_restart(tmp_path, rng):
+    """The id counter persists independently of open tasks: a restart after
+    everything finished must not reuse ids (the recordlog keys on them), and
+    finished tasks leave no residue in the config KV."""
+    from chubaofs_tpu.blobstore.scheduler import Scheduler
+
+    c = MiniCluster(str(tmp_path), n_nodes=9, disks_per_node=2)
+    try:
+        loc = c.access.put(blob_bytes(rng, 300_000))
+        vol = c.cm.get_volume(loc.blobs[0].vid)
+        unit = vol.units[0]
+        c.nodes[unit.node_id].lose_shard(unit.vuid, loc.blobs[0].bid)
+        c.proxy.send_shard_repair(vol.vid, loc.blobs[0].bid, [0], "t")
+        c.run_background_once()  # task t1 created and FINISHED
+        done = c.scheduler.tasks()
+        assert done and all(t.state == "finished" for t in done)
+        used_ids = {t.task_id for t in done}
+
+        sched2 = Scheduler(c.cm, c.proxy, c.nodes, codec=c.codec)
+        assert sched2.tasks() == []  # no tombstone residue reloads
+        assert not any(k.startswith("task/") for k in c.cm.config)
+        fresh = sched2.drop_disk(unit.disk_id)
+        assert fresh.task_id not in used_ids
+    finally:
+        c.close()
