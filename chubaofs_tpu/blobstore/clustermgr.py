@@ -365,6 +365,11 @@ class ClusterMgr:
         with self._lock:
             return self.config.get(key, default)
 
+    def config_items(self, prefix: str = "") -> list[tuple[str, str]]:
+        """Locked snapshot of config entries under a key prefix."""
+        with self._lock:
+            return [(k, v) for k, v in self.config.items() if k.startswith(prefix)]
+
     # -- health views --------------------------------------------------------
 
     def broken_disks(self) -> list[DiskInfo]:

@@ -104,8 +104,8 @@ class Scheduler:
         The id counter persists separately so completed tasks' ids are never
         reissued (the recordlog audit keys on them)."""
         self._seq = int(self.cm.get_config(self._TASK_SEQ_KEY) or 0)
-        for key, raw in list(self.cm.config.items()):
-            if not key.startswith(self._TASK_PREFIX) or not raw:
+        for key, raw in self.cm.config_items(self._TASK_PREFIX):
+            if not raw:
                 continue
             t = Task(**json.loads(raw))
             if t.state == TASK_WORKING:

@@ -222,15 +222,19 @@ class CodecService:
         stack = np.zeros((len(jobs), jobs[0].data.shape[0], kb), np.uint8)
         for i, j in enumerate(jobs):
             stack[i, :, : j.k] = j.data
+        # both paths go through the host-boundary grouped entry: batches of
+        # stripes are viewed (free numpy reshape) as MXU-row-filling groups
+        # before they ever reach the device (rs.gf_matmul_hostbatch)
         if sig[0] == "encode":
             kernel = rs.get_kernel(jobs[0].n, jobs[0].m)
-            out = np.asarray(kernel.encode(stack))  # (B, n+m, kb)
+            parity = rs.gf_matmul_hostbatch(kernel.parity_bits, stack)
+            out = np.concatenate([stack, parity], axis=1)  # (B, n+m, kb)
         else:
             from chubaofs_tpu.ops import bitmatrix
-            import jax.numpy as jnp
 
-            mat_bits = jnp.asarray(bitmatrix.expand_matrix(jobs[0].mat).astype(np.int8))
-            out = np.asarray(rs.gf_matmul_dispatch(mat_bits, stack))
+            out = rs.gf_matmul_hostbatch(
+                bitmatrix.expand_matrix(jobs[0].mat).astype(np.int8), stack
+            )
         for i, j in enumerate(jobs):
             j.future.set_result(out[i, :, : j.k])
 

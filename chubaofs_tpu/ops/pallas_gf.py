@@ -34,6 +34,10 @@ BITS = 8
 # ~53.4 GB/s encode) — fewer grid steps amortize per-tile overhead while the
 # (12+4)x128KiB working set still double-buffers in VMEM
 DEFAULT_TILE_K = 131072
+# per-grid-step in+out block budget for the adaptive tile choice: ~2 MiB is
+# the measured sweet spot at every stacking factor (G=1:128K, G=2:64K,
+# G=4:32K tiles all sit on (n+r)*kt ~= 2 MiB and all beat their neighbours)
+TILE_BYTES = 2 << 20
 
 
 def _perm(dim: int) -> list[int]:
@@ -45,6 +49,33 @@ def plane_major(mat_bits: np.ndarray) -> np.ndarray:
     """Permute a byte-major (8r, 8n) GF(2) matrix to the kernel's plane-major order."""
     r8, n8 = mat_bits.shape
     return np.asarray(mat_bits)[_perm(r8 // BITS)][:, _perm(n8 // BITS)]
+
+
+def pick_group(b: int, r8: int, n8: int) -> int:
+    """Largest divisor g of the batch with g*r8 <= 128 and g*n8 <= 512.
+
+    Block-diagonal generator stacking (PERF.md "paths past 100"): the stationary
+    matrix of one EC(12,4) stripe is 32x96 on a 128x128 systolic array (~19%
+    utilized). Stacking g stripes' generators block-diagonally (kron(I_g, mat))
+    and viewing g stripes as one wide (g*n, k) stripe fills the MXU rows —
+    measured on v5e-1: EC(12,4) encode 54 -> ~130 GB/s at g=4 (rows=128).
+    Beyond 128 rows (a second row-tile) throughput regresses, hence the cap.
+
+    The grouping MUST happen at the host boundary ((b, n, k) -> (b/g, g*n, k)
+    is a free numpy view there): on device the same reshape physically
+    rearranges the sublane-tiled HBM buffer (measured 131 -> 53 GB/s fed
+    through an in-jit reshape), and every in-kernel merge variant (4D block +
+    VMEM reshape, per-slab unpack + concat, slab-loop matmul accumulation)
+    defeats Mosaic's streaming fusion and blows the 16M scoped-VMEM limit.
+    rs.group_stack packages the host-side transform.
+    """
+    best = 1
+    for g in range(2, min(b, 128) + 1):
+        if g * r8 > 128 or g * n8 > 512:
+            break
+        if b % g == 0:
+            best = g
+    return best
 
 
 def _gf_kernel(mat_ref, data_ref, out_ref):
@@ -71,18 +102,27 @@ def _gf_kernel(mat_ref, data_ref, out_ref):
     out_ref[0] = packed.astype(jnp.uint8)
 
 
-@functools.partial(jax.jit, static_argnames=("tile_k", "interpret"))
 def gf_matmul_bytes_fused(
     mat_bits: jax.Array,
     shards: jax.Array,
-    tile_k: int = DEFAULT_TILE_K,
+    tile_k: int | None = None,
     interpret: bool = False,
 ) -> jax.Array:
     """Drop-in fused equivalent of rs.gf_matmul_bytes.
 
-    mat_bits: (8r, 8n) int8 in the standard byte-major order (the plane-major
-    permutation happens here, traced once under jit); shards: (..., n, k) uint8
-    -> (..., r, k) uint8. k is padded to the tile size internally and sliced back.
+    mat_bits: (8r, 8n) int8 in the standard byte-major order; shards:
+    (..., n, k) uint8 -> (..., r, k) uint8. k is padded to the tile size
+    internally and sliced back.
+
+    Host numpy matrices (the rs.py contract: generator and repair matrices
+    stay numpy) are permuted to the kernel's plane-major layout in numpy at
+    trace time; traced/device matrices (e.g. repair plans fed as runtime args
+    through shard_map) pay a tiny in-graph gather instead — one compiled
+    program keeps serving every repair pattern with no recompilation.
+
+    For MXU-filling batched throughput, feed GROUP-STACKED operands (see
+    rs.group_stack / pick_group): a (8gr, 8gn) block-diagonal matrix over
+    (b/g, g*n, k) host-viewed stripes.
     """
     r8, n8 = mat_bits.shape
     r, n = r8 // BITS, n8 // BITS
@@ -92,20 +132,47 @@ def gf_matmul_bytes_fused(
     if r8 == 0 or k == 0:
         return jnp.zeros((*lead, r, k), jnp.uint8)
 
-    mat_pm = mat_bits[jnp.asarray(_perm(r))][:, jnp.asarray(_perm(n))]
+    b = 1
+    for d in lead:
+        b *= d
+
+    if isinstance(mat_bits, np.ndarray):
+        # numpy at trace time: the device never sees the permutation
+        mat_pm = plane_major(mat_bits).astype(np.int8)
+    else:
+        mat_pm = mat_bits[jnp.asarray(_perm(r))][:, jnp.asarray(_perm(n))]
+
+    out = _fused_core(mat_pm, shards.reshape(b, n, k), tile_k=tile_k, interpret=interpret)
+    return out.reshape(*lead, r, k)
+
+
+@functools.partial(jax.jit, static_argnames=("tile_k", "interpret"))
+def _fused_core(
+    mat_pm: jax.Array,
+    data: jax.Array,
+    tile_k: int | None,
+    interpret: bool,
+) -> jax.Array:
+    """Jitted core: (b, n, k) uint8 -> (b, r, k) uint8 via the Pallas kernel.
+
+    mat_pm is already in the kernel's plane-major layout.
+    """
+    b, n, k = data.shape
+    r8, n8 = mat_pm.shape
+    r = r8 // BITS
+
+    if tile_k is None:
+        # keep the per-step in+out block near TILE_BYTES: measured sweet spot
+        # at every matrix width ((12+4)x128K, (24+8)x64K, (48+16)x32K all win)
+        tile_k = max(128, min(DEFAULT_TILE_K, TILE_BYTES // (n + r) // 128 * 128))
 
     # Mosaic pads sub-tile sublane counts up to full int8 tiles (32 sublanes),
     # so with few shard rows the unpack intermediates cost ~8*32 bytes/column
     # regardless of n and the scoped-VMEM stack blows the 16M limit at large
     # tiles (measured: n=3, r=1 at kt=128K needs 30.8M). Narrow tiles keep the
-    # stack bounded; wide stripes keep the measured-fast 128K tile.
+    # stack bounded; wide (possibly group-stacked) stripes keep larger tiles.
     if min(n, r) < 8:
         tile_k = min(tile_k, 32768)
-
-    b = 1
-    for d in lead:
-        b *= d
-    data = shards.reshape(b, n, k)
 
     # pick the tile so the grid divides evenly with minimal padding: distribute
     # the 128-aligned length over ceil(k/tile_k) tiles (pad <= 128 * n_tiles
@@ -134,4 +201,4 @@ def gf_matmul_bytes_fused(
 
     if kp != k:
         out = out[..., :k]
-    return out.reshape(*lead, r, k)
+    return out

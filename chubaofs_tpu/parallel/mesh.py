@@ -90,9 +90,11 @@ def sharded_codec_step(
         if use_fused:
             from chubaofs_tpu.ops import pallas_gf
 
-            return pallas_gf.gf_matmul_bytes_fused(
-                jnp.asarray(mat_bits), x, interpret=interpret
-            )
+            # numpy matrices (the generator) pass through unconverted so the
+            # plane-major permutation runs in numpy at trace time; group
+            # stacking does NOT apply here — the per-device layout is still
+            # per-stripe (PERF.md "remaining headroom" item 3)
+            return pallas_gf.gf_matmul_bytes_fused(mat_bits, x, interpret=interpret)
         return rs.gf_matmul_bytes(jnp.asarray(mat_bits), x)
 
     sp_size = mesh.shape["sp"]

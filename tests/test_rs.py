@@ -145,6 +145,69 @@ def test_plane_major_permutation_exact():
                     assert pm[b * r + p, b2 * n + j] == bits[p * 8 + b, j * 8 + b2]
 
 
+def test_pick_group_caps_and_divisibility():
+    from chubaofs_tpu.ops import pallas_gf
+
+    # EC(12,4): 32x96 bits -> g=4 fills exactly 128 rows
+    assert pallas_gf.pick_group(16, 32, 96) == 4
+    assert pallas_gf.pick_group(64, 16, 32) == 8  # EC(4,2), col cap 512 allows 8
+    assert pallas_gf.pick_group(7, 32, 96) == 1  # prime batch: no divisor
+    for b, r8, n8 in [(24, 24, 48), (64, 16, 32), (16, 32, 96), (8, 48, 160)]:
+        g = pallas_gf.pick_group(b, r8, n8)
+        assert b % g == 0 and g * r8 <= 128 and g * n8 <= 512
+
+
+def test_group_stacked_math_matches_per_stripe(rng):
+    """kron(I_g, mat) over the (b/g, g*n, k) view == per-stripe matmul."""
+    ker = rs.get_kernel(6, 3)
+    b, n, k = 8, 6, 256
+    g = 4
+    host = rng.integers(0, 256, (b, n, k), dtype=np.uint8)
+    want = np.asarray(rs.gf_matmul_bytes(ker.parity_bits, host))
+    mat_s = np.kron(np.eye(g, dtype=np.int8), ker.parity_bits)
+    got = np.asarray(
+        rs.gf_matmul_bytes(mat_s, host.reshape(b // g, g * n, k))
+    ).reshape(b, 3, k)
+    assert np.array_equal(got, want)
+
+
+def test_fused_kernel_group_stacked_interpret(rng):
+    """The Pallas kernel on group-stacked (wide) shapes matches the oracle."""
+    from chubaofs_tpu.ops import pallas_gf
+
+    ker = rs.get_kernel(6, 3)
+    b, n, k = 4, 6, 384
+    g = 4  # rows 4*24=96 <= 128
+    host = rng.integers(0, 256, (b, n, k), dtype=np.uint8)
+    want = np.asarray(rs.gf_matmul_bytes(ker.parity_bits, host))
+    mat_s = np.kron(np.eye(g, dtype=np.int8), ker.parity_bits)
+    got = np.asarray(
+        pallas_gf.gf_matmul_bytes_fused(
+            mat_s, host.reshape(b // g, g * n, k), tile_k=128, interpret=True
+        )
+    ).reshape(b, 3, k)
+    assert np.array_equal(got, want)
+
+
+def test_hostbatch_matches_dispatch(rng):
+    """gf_matmul_hostbatch: host (..., n, k) in -> host (..., r, k), oracle-equal."""
+    ker = rs.get_kernel(12, 4)
+    host = rng.integers(0, 256, (6, 12, 200), dtype=np.uint8)
+    want = np.asarray(rs.gf_matmul_bytes(ker.parity_bits, host))
+    got = rs.gf_matmul_hostbatch(ker.parity_bits, host)
+    assert isinstance(got, np.ndarray)
+    assert np.array_equal(got, want)
+    # repair matrix path (non-square, fewer rows)
+    mat, present, missing = ker.repair_matrix([0, 5])
+    from chubaofs_tpu.ops import bitmatrix
+
+    mat_bits = bitmatrix.expand_matrix(mat).astype(np.int8)
+    stripes = np.asarray(ker.encode(host))
+    sur = stripes[:, present, :]
+    rows = rs.gf_matmul_hostbatch(mat_bits, sur)
+    assert np.array_equal(rows, stripes[:, missing, :])
+
+
 def test_fused_kernel_empty_repair_matrix():
     """A repair plan with no missing rows must not crash the fused path."""
     from chubaofs_tpu.ops import pallas_gf
