@@ -323,6 +323,8 @@ class MasterDaemon(_Daemon):
         # partitions demote to read-only until they come back
         self.master.check_node_liveness(timeout=10 * HEARTBEAT_INTERVAL)
         self.master.check_data_partitions()
+        # durable repair: replicas on long-dead nodes re-home to healthy peers
+        self.master.check_dead_node_replicas(dead_after=60 * HEARTBEAT_INTERVAL)
         now = time.time()
         for vol in list(self.sm.volumes.values()):
             for mp in vol.meta_partitions:

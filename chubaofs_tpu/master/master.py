@@ -408,6 +408,9 @@ class Master:
         # the retired replica
         self.raft_config_hook = None
         self.remove_partition_hook = None
+        # nodes already fully drained by the dead-node sweep; in-memory only
+        # (rebuilt by one sweep after a restart), cleared on returning heartbeat
+        self._dead_drained: set[int] = set()
 
     def _apply(self, op: str, **args):
         res = self.raft.propose(MASTER_GROUP, (op, args)).result(timeout=5)
@@ -437,6 +440,9 @@ class Master:
         return out
 
     def heartbeat(self, node_id: int, partition_count: int = 0, cursors: dict | None = None):
+        # a returning node may receive new placements again, so the dead-node
+        # sweep must re-examine it if it dies a second time
+        self._dead_drained.discard(node_id)
         self._apply("heartbeat", node_id=node_id, partition_count=partition_count,
                     cursors=cursors, now=time.time())
 
@@ -750,6 +756,61 @@ class Master:
                                 partition_id=dp.partition_id, status=want)
                     changed += 1
         return changed
+
+    def _replica_count(self, node_id: int) -> int:
+        """Partition replicas currently homed on node_id (any kind)."""
+        c = 0
+        for vol in list(self.sm.volumes.values()):
+            for mp in vol.meta_partitions:
+                if node_id in mp.peers:
+                    c += 1
+            for dp in vol.data_partitions:
+                if node_id in dp.peers:
+                    c += 1
+        return c
+
+    def check_dead_node_replicas(self, dead_after: float = 60.0,
+                                 now: float | None = None) -> int:
+        """Durable auto-repair for nodes that STAY dead (reference
+        scheduleToCheckDataReplicas + the decommission flows, cluster.go:347):
+        liveness marks a stale node inactive within seconds (writes route
+        around it, dps demote to ro); once the outage exceeds ``dead_after``
+        this loop re-homes every replica the node held onto healthy peers,
+        reusing the decommission dance. The node record stays ``inactive`` —
+        a returning node reactivates on its next heartbeat and simply hosts
+        nothing (its stale raft groups reject it; the partitions were moved).
+        Per-node failures (e.g. no spare peers yet) keep whatever progress
+        was made and retry on the next sweep. Fully-drained nodes enter an
+        in-memory skip set (cleared by a returning heartbeat) so a cluster
+        with long-dead nodes doesn't rescan every partition each tick.
+        Returns replicas actually moved (counted by before/after census, so
+        partial drains are reported honestly)."""
+        if not self.is_leader:
+            return 0
+        now = time.time() if now is None else now
+        moved = 0
+        for n in list(self.sm.nodes.values()):
+            if n.status != "inactive" or n.node_id in self._dead_drained:
+                continue
+            if not n.last_heartbeat or now - n.last_heartbeat < dead_after:
+                continue
+            with self._decomm_lock:
+                before = self._replica_count(n.node_id)
+                if before == 0:
+                    self._dead_drained.add(n.node_id)
+                    continue
+                try:
+                    if n.kind == "meta":
+                        self._migrate_metanode(n.node_id)
+                    else:
+                        self._migrate_datanode(n.node_id)
+                except MasterError:
+                    pass  # partial progress kept; retried next sweep
+                remaining = self._replica_count(n.node_id)
+                moved += before - remaining
+                if remaining == 0:
+                    self._dead_drained.add(n.node_id)
+        return moved
 
     def refresh_leaders(self, leader_of) -> None:
         """Record partition leaders into the view (client routing hint)."""

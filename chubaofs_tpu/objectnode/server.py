@@ -224,6 +224,12 @@ class ObjectNode:
         r.put("/:bucket/*key", w(self.put_object_tagging), queries={"tagging": None})
         r.delete("/:bucket/*key", w(self.delete_object_tagging),
                  queries={"tagging": None})
+        # object xattr (CubeFS-owned API, ref router.go:77-91,340-345; GET
+        # branches on ?key= between single-get and list inside the handler)
+        r.get("/:bucket/*key", w(self.get_object_xattr), queries={"xattr": None})
+        r.put("/:bucket/*key", w(self.put_object_xattr), queries={"xattr": None})
+        r.delete("/:bucket/*key", w(self.delete_object_xattr),
+                 queries={"xattr": None})
         # multipart
         r.post("/:bucket/*key", w(self.initiate_multipart), queries={"uploads": None})
         r.put("/:bucket/*key", w(self.upload_part),
@@ -249,8 +255,7 @@ class ObjectNode:
                 return _xml_error(S3Error(404, "NoSuchKey", str(e)), req.path)
             except ReservedKey as e:
                 return _xml_error(
-                    S3Error(400, "InvalidArgument",
-                            f"key {e} addresses the reserved version store"),
+                    S3Error(400, "InvalidArgument", f"key {e} is reserved"),
                     req.path)
             except NoSuchUpload as e:
                 return _xml_error(S3Error(404, "NoSuchUpload", str(e)), req.path)
@@ -760,6 +765,62 @@ class ObjectNode:
         bucket, key = req.params["bucket"], req.params["key"]
         self._check(req, bucket, ACTION_DELETE, key)
         self._vol(bucket).delete_tagging(key)
+        return Response(204)
+
+    # -- object xattr (CubeFS-owned extension, ref api_handler_object.go:1491-
+    # 1691: XML bodies PutXAttrRequest/GetXAttrOutput/ListXAttrsResult) ----------
+
+    def put_object_xattr(self, req: Request):
+        bucket, key = req.params["bucket"], req.params["key"]
+        self._check(req, bucket, ACTION_PUT, key)
+        try:
+            root = _parse_xml(req.body)  # <PutXAttrRequest><XAttr>...
+            x = root.find("XAttr")
+            if x is None:
+                x = root
+            name, value = _text(x, "Key"), _text(x, "Value")
+        except S3Error:
+            raise
+        except Exception:
+            raise S3Error(400, "BadRequest", "malformed PutXAttrRequest") from None
+        if not name:
+            return Response(200)  # ref: empty key is a silent no-op
+        self._vol(bucket).set_xattr(key, name, value.encode())
+        return Response(200)
+
+    def get_object_xattr(self, req: Request):
+        bucket, key = req.params["bucket"], req.params["key"]
+        self._check(req, bucket, ACTION_GET, key)
+        vol = self._vol(bucket)
+        if not req.has_q("key"):  # ListXAttrs: GET ?xattr without key=
+            keys = "".join(f"<Keys>{esc(k)}</Keys>" for k in vol.list_xattrs(key))
+            return Response.xml(f"<ListXAttrsResult>{keys}</ListXAttrsResult>")
+        name = req.q("key")
+        if not name:
+            raise S3Error(400, "InvalidArgument", "key is required")
+        try:
+            value = vol.get_xattr(key, name)
+        except FsError as e:
+            if e.code == "ENODATA":
+                value = b""  # ref: missing attribute reads as empty value
+            else:
+                raise
+        return Response.xml(
+            f"<GetXAttrOutput><XAttr><Key>{esc(name)}</Key>"
+            f"<Value>{esc(value.decode('utf-8', 'replace'))}</Value>"
+            f"</XAttr></GetXAttrOutput>")
+
+    def delete_object_xattr(self, req: Request):
+        bucket, key = req.params["bucket"], req.params["key"]
+        self._check(req, bucket, ACTION_DELETE, key)
+        name = req.q("key")
+        if not name:
+            raise S3Error(400, "InvalidArgument", "key is required")
+        try:
+            self._vol(bucket).delete_xattr(key, name)
+        except FsError as e:
+            if e.code != "ENODATA":
+                raise
         return Response(204)
 
     # -- multipart ---------------------------------------------------------------

@@ -172,6 +172,36 @@ class OSSVolume:
         self.info(key)
         self.fs.removexattr("/" + key.rstrip("/"), XATTR_TAGGING)
 
+    # -- object xattr passthrough (ref objectnode SetXAttr/GetXAttr/DeleteXAttr/
+    # ListXAttrs, fs_volume.go:288-459). Deliberate divergence from the
+    # reference: internal oss:* keys (ACL, etag, version ids, delete markers)
+    # are NOT reachable through this API — the reference exposes them raw, but
+    # here the ACL/versioning engines key their permission checks off those
+    # xattrs, so a plain-WRITE principal writing oss:acl would bypass the
+    # WRITE_ACP/READ_ACP split. The version store is guarded like every other
+    # object verb. --------------------------------------------------------------
+
+    _XATTR_INTERNAL = "oss:"
+
+    def _xattr_path(self, key: str, name: str | None = None) -> str:
+        _guard_key(key)
+        if name is not None and name.startswith(self._XATTR_INTERNAL):
+            raise ReservedKey(name)
+        return "/" + key.rstrip("/")
+
+    def set_xattr(self, key: str, name: str, value: bytes):
+        self.fs.setxattr(self._xattr_path(key, name), name, value)
+
+    def get_xattr(self, key: str, name: str) -> bytes:
+        return self.fs.getxattr(self._xattr_path(key, name), name)
+
+    def delete_xattr(self, key: str, name: str):
+        self.fs.removexattr(self._xattr_path(key, name), name)
+
+    def list_xattrs(self, key: str) -> list[str]:
+        return [k for k in self.fs.listxattr(self._xattr_path(key))
+                if not k.startswith(self._XATTR_INTERNAL)]
+
     # -- xattr passthrough for bucket-level configs (acl/policy/cors) ------------
 
     def get_bucket_xattr(self, key: str) -> bytes | None:

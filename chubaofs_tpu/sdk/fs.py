@@ -312,3 +312,11 @@ class FsClient:
             self.meta.remove_xattr(self.resolve(path), key)
         except OpError as e:
             raise FsError(e.code, path) from None
+
+    def listxattr(self, path: str) -> list[str]:
+        """All extended-attribute keys on path (ref objectnode ListXAttrs)."""
+        try:
+            inode = self.meta.get_inode(self.resolve(path))
+        except OpError as e:
+            raise FsError(e.code, path) from None
+        return sorted(inode.xattrs)

@@ -515,3 +515,58 @@ def test_copy_applies_canned_acl(s3env):
                  "x-amz-acl": "public-read"})
     status, _, body = req(s3, "GET", "/aclbkt3/dst", raw_query="acl")
     assert status == 200 and b"<Grantee>*</Grantee>" in body
+
+
+def test_object_xattr_put_get_list_delete(s3env):
+    """CubeFS-owned xattr API (ref router.go:77-91,340-345)."""
+    s3, _ = s3env
+    req(s3, "PUT", "/xbkt")
+    req(s3, "PUT", "/xbkt/obj", body=b"payload")
+    body = (b"<PutXAttrRequest><XAttr><Key>user.color</Key>"
+            b"<Value>teal</Value></XAttr></PutXAttrRequest>")
+    status, _, _ = req(s3, "PUT", "/xbkt/obj", body=body, raw_query="xattr")
+    assert status == 200
+    # single get
+    status, _, out = req(s3, "GET", "/xbkt/obj", raw_query="xattr&key=user.color")
+    assert status == 200
+    x = xml_of(out)
+    assert x.find("XAttr/Key").text == "user.color"
+    assert x.find("XAttr/Value").text == "teal"
+    # list includes the user key; internal oss:* keys are NOT exposed (the
+    # ACL/versioning engines key permissions off them — see volume.py)
+    status, _, out = req(s3, "GET", "/xbkt/obj", raw_query="xattr")
+    keys = [k.text for k in xml_of(out).iter("Keys")]
+    assert "user.color" in keys and not any(k.startswith("oss:") for k in keys)
+    # delete, then the key is gone from the listing and reads empty
+    status, _, _ = req(s3, "DELETE", "/xbkt/obj", raw_query="xattr&key=user.color")
+    assert status == 204
+    _, _, out = req(s3, "GET", "/xbkt/obj", raw_query="xattr")
+    assert "user.color" not in [k.text for k in xml_of(out).iter("Keys")]
+    _, _, out = req(s3, "GET", "/xbkt/obj", raw_query="xattr&key=user.color")
+    assert xml_of(out).find("XAttr/Value").text is None  # empty value
+
+
+def test_object_xattr_errors(s3env):
+    s3, _ = s3env
+    req(s3, "PUT", "/xbkt2")
+    req(s3, "PUT", "/xbkt2/obj", body=b"x")
+    # delete without key= -> InvalidArgument
+    status, _, body = req(s3, "DELETE", "/xbkt2/obj", raw_query="xattr")
+    assert status == 400 and b"InvalidArgument" in body
+    # malformed body -> BadRequest
+    status, _, body = req(s3, "PUT", "/xbkt2/obj", body=b"not-xml",
+                          raw_query="xattr")
+    assert status == 400
+    # missing object -> NoSuchKey family
+    status, _, _ = req(s3, "GET", "/xbkt2/nope", raw_query="xattr")
+    assert status == 404
+    # internal oss:* keys are unreachable: no ACL forging via plain WRITE
+    body = (b"<PutXAttrRequest><XAttr><Key>oss:acl</Key>"
+            b"<Value>{}</Value></XAttr></PutXAttrRequest>")
+    status, _, out = req(s3, "PUT", "/xbkt2/obj", body=body, raw_query="xattr")
+    assert status == 400 and b"reserved" in out
+    status, _, out = req(s3, "GET", "/xbkt2/obj", raw_query="xattr&key=oss:etag")
+    assert status == 400 and b"reserved" in out
+    # the hidden version store is guarded like every other object verb
+    status, _, _ = req(s3, "GET", "/xbkt2/.versions/obj/v1", raw_query="xattr")
+    assert status == 400

@@ -431,6 +431,60 @@ def test_node_liveness_and_dp_health(master):
     assert len(master.data_partition_views("lv")) == 1
 
 
+def test_dead_node_replicas_auto_rehome(master):
+    """A node that stays dead past the threshold has its replicas migrated to
+    healthy peers without operator action (scheduleToCheckDataReplicas +
+    decommission-flow analog); a briefly-dead node is left alone."""
+    _register_grid(master, "meta", zones=3, per_zone=1, base=100)
+    _register_grid(master, "data", zones=3, per_zone=2, base=200)
+    now = time.time()
+    for n in master.sm.nodes.values():
+        n.last_heartbeat = now
+    vol = master.create_volume("arv", data_partitions=1)
+    dp = vol.data_partitions[0]
+    victim = dp.peers[0]
+
+    master.sm.nodes[victim].last_heartbeat = now - 30
+    assert master.check_node_liveness(timeout=10.0, now=now) == [victim]
+    assert master.check_data_partitions() == 1  # demoted to ro
+    # dead only 30s: liveness demoted it, but no migration yet
+    assert master.check_dead_node_replicas(dead_after=60.0, now=now) == 0
+    assert victim in master.sm.volumes["arv"].data_partitions[0].peers
+
+    # past the threshold: the replica re-homes and the dp heals back to rw
+    master.sm.nodes[victim].last_heartbeat = now - 120
+    assert master.check_dead_node_replicas(dead_after=60.0, now=now) == 1
+    new_peers = master.sm.volumes["arv"].data_partitions[0].peers
+    assert victim not in new_peers and len(new_peers) == 3
+    assert master.check_data_partitions() == 1
+    assert master.sm.volumes["arv"].data_partitions[0].status == "rw"
+    # the node record survives as inactive (it may return empty-handed)
+    assert master.sm.nodes[victim].status == "inactive"
+    # drained nodes enter the skip set; a returning heartbeat clears it
+    assert master.check_dead_node_replicas(dead_after=60.0, now=now) == 0
+    assert victim in master._dead_drained
+    master.heartbeat(victim)
+    assert victim not in master._dead_drained
+    assert master.sm.nodes[victim].status == "active"
+
+
+def test_dead_node_rehome_skips_without_spare_peers(master):
+    """No healthy replacement available -> the sweep skips and retries later
+    instead of erroring out."""
+    _register_grid(master, "meta", zones=3, per_zone=1, base=100)
+    _register_grid(master, "data", zones=3, per_zone=1, base=200)
+    now = time.time()
+    for n in master.sm.nodes.values():
+        n.last_heartbeat = now
+    master.create_volume("arv2", data_partitions=1)
+    victim = master.sm.volumes["arv2"].data_partitions[0].peers[0]
+    master.sm.nodes[victim].last_heartbeat = now - 120
+    master.check_node_liveness(timeout=10.0, now=now)
+    # only 3 data nodes exist; nothing to migrate to
+    assert master.check_dead_node_replicas(dead_after=60.0, now=now) == 0
+    assert victim in master.sm.volumes["arv2"].data_partitions[0].peers
+
+
 def test_liveness_leaves_decommissioned_alone(master):
     _register_grid(master, "meta", zones=3, per_zone=2, base=100)
     master.create_volume("dv", data_partitions=0, cold=True)
