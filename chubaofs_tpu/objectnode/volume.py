@@ -187,6 +187,7 @@ class OSSVolume:
         _guard_key(key)
         if name is not None and name.startswith(self._XATTR_INTERNAL):
             raise ReservedKey(name)
+        self.info(key)  # real objects only, like the tagging verbs (404 else)
         return "/" + key.rstrip("/")
 
     def set_xattr(self, key: str, name: str, value: bytes):

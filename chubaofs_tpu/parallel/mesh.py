@@ -56,28 +56,81 @@ def shard_stripes(mesh: Mesh, stripes) -> jax.Array:
     return jax.device_put(stripes, NamedSharding(mesh, P("dp", None, "sp")))
 
 
+def group_view(data: np.ndarray, g: int) -> np.ndarray:
+    """Host-boundary group view: (B, n, k) -> (B/g, g*n, k). A free numpy
+    reshape here; on device the same reshape physically rearranges the
+    sublane-tiled buffer (PERF.md "group stacking")."""
+    b, n, k = data.shape
+    assert b % g == 0, (b, g)
+    return data.reshape(b // g, g * n, k)
+
+
+def ungroup_stripe(stripe: np.ndarray, g: int, n: int, m: int,
+                   b: int | None = None) -> np.ndarray:
+    """Host-boundary inverse for encoded stripes: grouped (B/g, g*n + g*m, k)
+    -> per-stripe (B, n+m, k). The grouped layout keeps the g stripes' data
+    rows first and their parity rows after (block order), so the split is two
+    views plus one concatenate. Pass ``b`` (the original stripe count) to
+    drop the zero-padding stripes an uneven batch leaves inside the final
+    group — the device can't slice a partial group, so it happens here."""
+    stripe = np.asarray(stripe)
+    bg, rows, k = stripe.shape
+    assert rows == g * (n + m), (stripe.shape, g, n, m)
+    data = stripe[:, : g * n, :].reshape(bg * g, n, k)
+    par = stripe[:, g * n :, :].reshape(bg * g, m, k)
+    out = np.concatenate([data, par], axis=1)
+    return out[:b] if b is not None else out
+
+
+def _grouped_row(s: int, gi: int, g: int, n: int, m: int) -> int:
+    """Stripe-local shard index s (0..n+m) of slab gi -> grouped stripe row."""
+    return gi * n + s if s < n else g * n + gi * m + (s - n)
+
+
 def sharded_codec_step(
-    mesh: Mesh, n: int, m: int, *, fused: bool | None = None, interpret: bool = False
+    mesh: Mesh, n: int, m: int, *, fused: bool | None = None,
+    interpret: bool = False, group: int = 1
 ):
     """Jitted full codec step over the mesh: encode -> verify -> repair.
 
     This is the flagship distributed 'step' (the training-step analog): one batch
     of stripes goes through the complete PUT+scrub+repair pipeline. Returns
     ``run(data, bad_idx=(0, n))`` mapping (B, n, k) uint8 data stripes to
-    (stripe (B, n+m, k), ok (B,), repaired (B, n+m, k)).
+    (stripe, ok (B,), repaired).
 
     Sharding story: the step is a ``jax.shard_map`` over (dp, sp) — each device
-    runs the FUSED Pallas kernel on its local (B/dp, n, k/sp) block (GF math is
+    runs the FUSED Pallas kernel on its local block (GF math is
     columnwise-independent, so no collectives except verify's AND over sp,
     a psum on ICI). ``fused=None`` auto-selects: Pallas on TPU backends, the
     XLA einsum lowering elsewhere; ``interpret=True`` forces the Pallas kernel
     in interpret mode (CPU-mesh tests of the real kernel).
 
+    ``group=g`` runs the MXU group-stacked layout per device (PERF.md: the
+    single-chip 54 -> 122 GB/s step, carried to the sharded path): g stripes
+    are viewed as one wide (g*n, k) stripe AT THE HOST BOUNDARY (free numpy
+    reshape in ``run``) and all matrices — generator and runtime repair plans
+    alike — are kron-stacked to fill the MXU rows. With group > 1:
+      * pass HOST (numpy) batches — a device-resident input is staged through
+        the host (D2H + re-upload), because only the host view is free;
+      * the stripe and repaired outputs stay in the grouped device layout —
+        convert with ``ungroup_stripe(out, g, n, m, b=B)``, which also drops
+        the zero-pad stripes an uneven batch leaves inside the final group
+        (the device cannot slice a partial group);
+      * ``ok`` is always per-stripe and sliced to B.
+
     The repair pattern is RUNTIME data via ``repair_plan_padded`` — changing
-    ``bad_idx`` between calls never recompiles. Batches that don't divide dp
-    are zero-padded in and sliced out (zero stripes encode/verify trivially).
+    ``bad_idx`` between calls never recompiles (the kron stacking preserves
+    static shapes). Batches that don't divide dp*group are zero-padded in and
+    sliced out (zero stripes encode/verify trivially).
     """
+    g = int(group)
+    assert g >= 1
     kernel = rs.get_kernel(n, m)
+    gn, gm = g * n, g * m
+    if g == 1:
+        parity_bits = kernel.parity_bits
+    else:
+        parity_bits = np.kron(np.eye(g, dtype=np.int8), kernel.parity_bits)
     # auto-select keys off the MESH's platform, not the default backend: under
     # axon the default is a proxied TPU while the dryrun mesh is CPU devices —
     # compiling the Mosaic kernel for a CPU mesh would crash the dryrun
@@ -90,10 +143,10 @@ def sharded_codec_step(
         if use_fused:
             from chubaofs_tpu.ops import pallas_gf
 
-            # numpy matrices (the generator) pass through unconverted so the
-            # plane-major permutation runs in numpy at trace time; group
-            # stacking does NOT apply here — the per-device layout is still
-            # per-stripe (PERF.md "remaining headroom" item 3)
+            # numpy matrices (the generator — kron-stacked already when
+            # group > 1) pass through unconverted so the plane-major
+            # permutation runs in numpy at trace time; traced repair matrices
+            # pay a tiny in-graph gather instead
             return pallas_gf.gf_matmul_bytes_fused(mat_bits, x, interpret=interpret)
         return rs.gf_matmul_bytes(jnp.asarray(mat_bits), x)
 
@@ -111,12 +164,15 @@ def sharded_codec_step(
     )
     def step(data, repair_bits, present, missing):
         trace_count[0] += 1  # trace-time only: counts compilations, not calls
-        parity = gf(kernel.parity_bits, data)  # (B/dp, m, k/sp) per device
+        parity = gf(parity_bits, data)  # (B/(dp*g), g*m, k/sp) per device
         stripe = jnp.concatenate([data, parity], axis=-2)
-        # verify: recompute parity from the stripe's data rows, AND over sp
-        expect = gf(kernel.parity_bits, stripe[..., :n, :])
-        ok_local = jnp.all(expect == stripe[..., n:, :], axis=(-2, -1))
+        # verify: recompute parity from the stripe's data rows, AND over sp;
+        # row-wise first so ok stays PER STRIPE even in the grouped layout
+        expect = gf(parity_bits, stripe[..., :gn, :])
+        eq_rows = jnp.all(expect == stripe[..., gn:, :], axis=-1)  # (b, g*m)
+        ok_local = jnp.all(eq_rows.reshape(*eq_rows.shape[:-1], g, m), axis=-1)
         ok = jax.lax.psum(ok_local.astype(jnp.int32), "sp") == sp_size
+        ok = ok.reshape(-1)  # (b*g,): per original stripe
         # repair: survivors -> missing rows via the runtime plan
         survivors = jnp.take(stripe, present, axis=-2)
         rows = gf(repair_bits, survivors)
@@ -129,28 +185,50 @@ def sharded_codec_step(
     @functools.lru_cache(maxsize=64)
     def plan_for(bad: tuple) -> tuple:
         # once per pattern: the O(n^3) host-side inversion AND the replicated
-        # broadcast to every mesh device (repeat steps transfer nothing)
-        plan = kernel.repair_plan_padded(list(bad))
+        # broadcast to every mesh device (repeat steps transfer nothing).
+        # With group > 1 the plan is kron-stacked and its survivor/missing
+        # coordinates expanded to grouped stripe rows — shapes stay static,
+        # so changing patterns still never recompiles.
+        mat, present, missing = kernel.repair_plan_padded(list(bad))
+        if g > 1:
+            mat = np.kron(np.eye(g, dtype=np.int8), mat)
+            present = np.asarray(
+                [_grouped_row(int(s), gi, g, n, m)
+                 for gi in range(g) for s in present], np.int32)
+            missing = np.asarray(
+                [_grouped_row(int(s), gi, g, n, m)
+                 for gi in range(g) for s in missing], np.int32)
+        plan = (mat, present, missing)
         return tuple(jax.device_put(a, replicated) for a in plan)
 
     def run(data, bad_idx=(0, n)):
         args = plan_for(tuple(sorted(set(int(i) for i in bad_idx))))
+        if isinstance(data, jax.Array) and g > 1:
+            # the group view is only free at the host boundary: device inputs
+            # pay a D2H + re-upload here (see docstring — pass numpy batches)
+            data = np.asarray(data)
         if not isinstance(data, jax.Array):
             data = np.asarray(data)
         b = data.shape[0]
-        pad = (-b) % mesh.shape["dp"]
+        pad = (-b) % (mesh.shape["dp"] * g)
         if pad:
             # pad in the input's own space: device arrays stay on device
             xp = jnp if isinstance(data, jax.Array) else np
             data = xp.concatenate(
                 [data, xp.zeros((pad, *data.shape[1:]), xp.uint8)], axis=0
             )
+        if g > 1:
+            data = group_view(data, g)
         data = shard_stripes(mesh, data)
         with mesh:
-            out = jitted(data, *args)
+            stripe, ok, repaired = jitted(data, *args)
         if pad:
-            out = jax.tree.map(lambda x: x[:b], out)
-        return out
+            nb = b // g + (1 if b % g else 0) if g > 1 else b
+            stripe = stripe[:nb]
+            repaired = repaired[:nb]
+            ok = ok[:b]
+        return stripe, ok, repaired
 
     run.trace_count = trace_count
+    run.group = g
     return run

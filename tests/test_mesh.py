@@ -178,3 +178,66 @@ def test_graft_dryrun_entrypoint():
     import __graft_entry__ as ge
 
     ge.dryrun_multichip(8)
+
+
+# -- group-stacked sharded step (PERF.md: MXU row-filling on the mesh path) ----
+
+
+def test_grouped_step_matches_ungrouped(rng):
+    """group=2: grouped device layout, per-stripe results identical to the
+    per-stripe step after the host-boundary ungroup view."""
+    from chubaofs_tpu.parallel.mesh import ungroup_stripe
+
+    mesh = codec_mesh(dp=4, sp=2)
+    data = _data(rng, 16, 512)
+    run_g = sharded_codec_step(mesh, N, M, group=2)
+    stripe_g, ok_g, repaired_g = run_g(data, bad_idx=(1, N + 1))
+    run_1 = sharded_codec_step(mesh, N, M)
+    stripe_1, ok_1, repaired_1 = run_1(data, bad_idx=(1, N + 1))
+
+    assert np.asarray(stripe_g).shape == (8, 2 * (N + M), 512)
+    got = ungroup_stripe(np.asarray(stripe_g), 2, N, M)
+    np.testing.assert_array_equal(got, np.asarray(stripe_1))
+    np.testing.assert_array_equal(
+        ungroup_stripe(np.asarray(repaired_g), 2, N, M), np.asarray(repaired_1))
+    np.testing.assert_array_equal(np.asarray(ok_g), np.asarray(ok_1))
+    assert np.asarray(ok_g).shape == (16,)
+
+
+def test_grouped_step_fused_interpret(rng):
+    """The real Pallas kernel on the group-stacked per-device layout."""
+    from chubaofs_tpu.parallel.mesh import ungroup_stripe
+
+    mesh = codec_mesh(dp=4, sp=2)
+    data = _data(rng, 8, 384)
+    run = sharded_codec_step(mesh, N, M, interpret=True, group=2)
+    stripe, ok, repaired = run(data)
+    got = ungroup_stripe(np.asarray(stripe), 2, N, M)
+    np.testing.assert_array_equal(got, _oracle_encode(data))
+    assert bool(np.all(np.asarray(ok)))
+    np.testing.assert_array_equal(np.asarray(repaired), np.asarray(stripe))
+
+
+def test_grouped_step_per_stripe_ok_and_uneven_batch(rng):
+    """ok granularity stays per-stripe in the grouped layout, including when
+    the batch doesn't divide dp*group (padded in, sliced out)."""
+    mesh = codec_mesh(dp=4, sp=2)
+    run = sharded_codec_step(mesh, N, M, group=2)
+    data = _data(rng, 8, 256)
+    _, ok, _ = run(data)
+    assert np.asarray(ok).tolist() == [True] * 8
+
+    data7 = _data(rng, 7, 256)  # 7 % (dp*g = 8) != 0
+    _, ok7, _ = run(data7)
+    assert np.asarray(ok7).shape == (7,) and bool(np.all(np.asarray(ok7)))
+
+
+def test_grouped_runtime_plan_no_retrace(rng):
+    mesh = codec_mesh(dp=4, sp=2)
+    run = sharded_codec_step(mesh, N, M, group=2)
+    data = _data(rng, 8, 256)
+    s1, _, r1 = run(data, bad_idx=(0, N))
+    s2, _, r2 = run(data, bad_idx=(1, 2, N + 1))
+    np.testing.assert_array_equal(np.asarray(r1), np.asarray(s1))
+    np.testing.assert_array_equal(np.asarray(r2), np.asarray(s2))
+    assert run.trace_count[0] == 1, f"retraced: {run.trace_count[0]} traces"

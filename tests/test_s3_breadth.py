@@ -570,3 +570,7 @@ def test_object_xattr_errors(s3env):
     # the hidden version store is guarded like every other object verb
     status, _, _ = req(s3, "GET", "/xbkt2/.versions/obj/v1", raw_query="xattr")
     assert status == 400
+    # non-objects (implicit prefix dirs) are not addressable, like tagging
+    req(s3, "PUT", "/xbkt2/a/obj", body=b"y")
+    status, _, _ = req(s3, "GET", "/xbkt2/a", raw_query="xattr")
+    assert status == 404
