@@ -80,6 +80,16 @@ def _text(el, tag: str, default: str = "") -> str:
     return child.text or default if child is not None else default
 
 
+# sub-resources the reference routes to unsupportedOperationHandler
+# (router.go; v3.2.1 also lists lifecycle/versioning/versions there, which
+# THIS gateway implements)
+_UNSUPPORTED_BUCKET_QUERIES = (
+    "object-lock", "encryption", "website", "publicAccessBlock",
+    "requestPayment", "replication",
+)
+_UNSUPPORTED_OBJECT_QUERIES = ("legal-hold", "retention", "torrent", "restore")
+
+
 class ObjectNode:
     """cluster must provide: create_volume(name, cold), delete_volume(name),
     volume_names(), client(name) -> FsClient, data_backend. FsCluster does."""
@@ -209,6 +219,17 @@ class ObjectNode:
                  queries={"lifecycle": None})
         r.get("/:bucket", w(self.list_objects_v2), queries={"list-type": "2"})
         r.post("/:bucket", w(self.delete_objects), queries={"delete": None})
+        # unimplemented sub-resources answer 501 NotImplemented explicitly so
+        # they can't fall through to the catch-all core routes (e.g. a
+        # ?replication GET must not run ListObjects) — ref router.go registers
+        # unsupportedOperationHandler for exactly these (api_handler.go:130)
+        for q in _UNSUPPORTED_BUCKET_QUERIES:
+            for meth in ("GET", "PUT", "DELETE"):
+                r.handle(meth, "/:bucket", w(self.unsupported), queries={q: None})
+        for q in _UNSUPPORTED_OBJECT_QUERIES:
+            for meth in ("GET", "PUT", "DELETE", "POST"):
+                r.handle(meth, "/:bucket/*key", w(self.unsupported),
+                         queries={q: None})
         # bucket core
         r.get("/:bucket", w(self.list_objects_v1))
         r.put("/:bucket", w(self.create_bucket))
@@ -766,6 +787,16 @@ class ObjectNode:
         self._check(req, bucket, ACTION_DELETE, key)
         self._vol(bucket).delete_tagging(key)
         return Response(204)
+
+    def unsupported(self, req: Request):
+        """501 for sub-resources the gateway deliberately does not implement
+        (ref unsupportedOperationHandler, api_handler.go:130)."""
+        self._authenticate(req)
+        return _xml_error(
+            S3Error(501, "NotImplemented",
+                    "A header you provided implies functionality that is not "
+                    "implemented."),
+            req.path)
 
     # -- object xattr (CubeFS-owned extension, ref api_handler_object.go:1491-
     # 1691: XML bodies PutXAttrRequest/GetXAttrOutput/ListXAttrsResult) ----------

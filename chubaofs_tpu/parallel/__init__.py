@@ -2,8 +2,16 @@
 
 from chubaofs_tpu.parallel.mesh import (
     codec_mesh,
+    group_view,
     shard_stripes,
     sharded_codec_step,
+    ungroup_stripe,
 )
 
-__all__ = ["codec_mesh", "shard_stripes", "sharded_codec_step"]
+__all__ = [
+    "codec_mesh",
+    "group_view",
+    "shard_stripes",
+    "sharded_codec_step",
+    "ungroup_stripe",
+]

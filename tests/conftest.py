@@ -28,3 +28,18 @@ import pytest  # noqa: E402
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)
+
+
+def corrupt_shard_on_disk(node, vuid, bid, flip_at=10):
+    """Flip one payload byte inside a blobnode chunk's crc32block framing,
+    bypassing the API (shared fault injector for the hygiene and soak
+    suites — byte-offset-sensitive, keep the one copy)."""
+    from chubaofs_tpu.blobstore.blobnode import HEADER_LEN
+
+    chunk = node._chunk(vuid)
+    meta = chunk.shards[bid]
+    with open(chunk._data_path, "r+b") as f:
+        f.seek(meta.offset + HEADER_LEN + 4 + flip_at)  # into block 0 payload
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0xFF]))

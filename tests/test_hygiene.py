@@ -19,15 +19,7 @@ def blob_bytes(rng, n):
     return rng.integers(0, 256, n, dtype=np.uint8).tobytes()
 
 
-def corrupt_shard_on_disk(node, vuid, bid, flip_at=10):
-    """Flip one payload byte inside the crc32block framing, bypassing the API."""
-    chunk = node._chunk(vuid)
-    meta = chunk.shards[bid]
-    with open(chunk._data_path, "r+b") as f:
-        f.seek(meta.offset + HEADER_LEN + 4 + flip_at)  # into block 0 payload
-        b = f.read(1)
-        f.seek(-1, os.SEEK_CUR)
-        f.write(bytes([b[0] ^ 0xFF]))
+from conftest import corrupt_shard_on_disk  # noqa: E402 (shared injector)
 
 
 # -- chunk compaction ---------------------------------------------------------

@@ -12,7 +12,12 @@ import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from chubaofs_tpu.ops import gf256, rs
-from chubaofs_tpu.parallel import codec_mesh, shard_stripes, sharded_codec_step
+from chubaofs_tpu.parallel import (
+    codec_mesh,
+    shard_stripes,
+    sharded_codec_step,
+    ungroup_stripe,
+)
 
 N, M = 6, 3
 
@@ -186,8 +191,6 @@ def test_graft_dryrun_entrypoint():
 def test_grouped_step_matches_ungrouped(rng):
     """group=2: grouped device layout, per-stripe results identical to the
     per-stripe step after the host-boundary ungroup view."""
-    from chubaofs_tpu.parallel.mesh import ungroup_stripe
-
     mesh = codec_mesh(dp=4, sp=2)
     data = _data(rng, 16, 512)
     run_g = sharded_codec_step(mesh, N, M, group=2)
@@ -206,8 +209,6 @@ def test_grouped_step_matches_ungrouped(rng):
 
 def test_grouped_step_fused_interpret(rng):
     """The real Pallas kernel on the group-stacked per-device layout."""
-    from chubaofs_tpu.parallel.mesh import ungroup_stripe
-
     mesh = codec_mesh(dp=4, sp=2)
     data = _data(rng, 8, 384)
     run = sharded_codec_step(mesh, N, M, interpret=True, group=2)

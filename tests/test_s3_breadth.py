@@ -574,3 +574,21 @@ def test_object_xattr_errors(s3env):
     req(s3, "PUT", "/xbkt2/a/obj", body=b"y")
     status, _, _ = req(s3, "GET", "/xbkt2/a", raw_query="xattr")
     assert status == 404
+
+
+def test_unsupported_subresources_return_501(s3env):
+    """Unimplemented sub-resources answer NotImplemented instead of falling
+    through to the catch-all routes (ref unsupportedOperationHandler)."""
+    s3, _ = s3env
+    req(s3, "PUT", "/ubkt")
+    req(s3, "PUT", "/ubkt/o", body=b"x")
+    for q in ("replication", "website", "encryption", "object-lock",
+              "publicAccessBlock", "requestPayment"):
+        status, _, body = req(s3, "GET", "/ubkt", raw_query=q)
+        assert status == 501 and b"NotImplemented" in body, q
+    for q in ("legal-hold", "retention", "torrent", "restore"):
+        status, _, body = req(s3, "GET", "/ubkt/o", raw_query=q)
+        assert status == 501 and b"NotImplemented" in body, q
+    # implemented sub-resources are unaffected
+    assert req(s3, "GET", "/ubkt", raw_query="versioning")[0] == 200
+    assert req(s3, "GET", "/ubkt", raw_query="lifecycle")[0] in (200, 404)

@@ -19,21 +19,11 @@ import random
 import numpy as np
 import pytest
 
-from chubaofs_tpu.blobstore.blobnode import HEADER_LEN
 from chubaofs_tpu.blobstore.cluster import MiniCluster
 from chubaofs_tpu.blobstore.clustermgr import DISK_BROKEN, DISK_NORMAL
 
 
-def corrupt_shard_on_disk(node, vuid, bid, flip_at=10):
-    """Flip one payload byte inside the crc32block framing, bypassing the API
-    (same fault as test_hygiene's helper)."""
-    chunk = node._chunk(vuid)
-    meta = chunk.shards[bid]
-    with open(chunk._data_path, "r+b") as f:
-        f.seek(meta.offset + HEADER_LEN + 4 + flip_at)
-        b = f.read(1)
-        f.seek(-1, os.SEEK_CUR)
-        f.write(bytes([b[0] ^ 0xFF]))
+from conftest import corrupt_shard_on_disk  # noqa: E402 (shared injector)
 
 SEED = 1234
 ROUNDS = 8
@@ -111,10 +101,11 @@ def test_fault_injection_soak(tmp_path, seed):
             for vol in c.cm.volumes.values():
                 for u in vol.units:
                     per_disk[u.disk_id] = per_disk.get(u.disk_id, 0) + 1
-            for disk_id, want in per_disk.items():
-                got = c.cm.disks[disk_id].chunk_count
-                assert got == want, (
-                    f"round {rnd_no}: disk {disk_id} counts {got} != {want}")
+            for disk_id, disk in c.cm.disks.items():
+                want = per_disk.get(disk_id, 0)
+                assert disk.chunk_count == want, (
+                    f"round {rnd_no}: disk {disk_id} counts "
+                    f"{disk.chunk_count} != {want}")
 
         # final heal: drain all planes, then a fresh sweep must be quiet
         for _ in range(10):
