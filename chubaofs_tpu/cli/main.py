@@ -80,6 +80,22 @@ class CLI:
         table(rows, ["id", "kind", "addr", "zone", "status", "partitions"],
               self.out)
 
+    def cluster_stat(self, args):
+        st = self.mc.cluster_stat()
+        if self.as_json:
+            return self._emit(st)
+        gib = 1 << 30
+        print(f"Space      : {st['used_space'] / gib:.1f} / "
+              f"{st['total_space'] / gib:.1f} GiB used", file=self.out)
+        print(f"Nodes      : {st['active']}/{st['nodes']} active", file=self.out)
+        print(f"Volumes    : {st['volumes']} "
+              f"(mp={st['meta_partitions']} dp={st['data_partitions']})",
+              file=self.out)
+        for zone, z in sorted(st["zones"].items()):
+            print(f"  zone {zone or '-'}: {z['active']}/{z['nodes']} active, "
+                  f"{z['used_space'] / gib:.1f}/{z['total_space'] / gib:.1f} GiB",
+                  file=self.out)
+
     def cluster_topology(self, args):
         """Zones -> nodesets -> nodes, rendered from the master's own
         topology view (`cfs-cli zone list` analog)."""
@@ -224,6 +240,7 @@ def build_parser() -> argparse.ArgumentParser:
     cluster = sub.add_parser("cluster").add_subparsers(dest="verb", required=True)
     cluster.add_parser("info").set_defaults(fn="cluster_info")
     cluster.add_parser("topology").set_defaults(fn="cluster_topology")
+    cluster.add_parser("stat").set_defaults(fn="cluster_stat")
 
     vol = sub.add_parser("vol", aliases=["volume"]).add_subparsers(
         dest="verb", required=True)

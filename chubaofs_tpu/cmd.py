@@ -101,6 +101,35 @@ def _resolve_raft_peers(mc: MasterClient, net: TcpNet) -> None:
         pass
 
 
+def _space_report(paths) -> dict:
+    """Disk usage of the daemon's data roots, reported with heartbeats into
+    the master's statinfo rollup (ref scheduleToUpdateStatInfo source).
+
+    Accepts one path or a list; filesystems are deduplicated by st_dev so two
+    data dirs on one mount don't double-count. No paths -> no report ({})."""
+    if not paths:
+        return {}
+    if isinstance(paths, str):
+        paths = [paths]
+    import os as _os
+    import shutil
+
+    total = used = 0
+    seen: set[int] = set()
+    for p in paths:
+        try:
+            dev = _os.stat(p).st_dev
+            if dev in seen:
+                continue
+            seen.add(dev)
+            du = shutil.disk_usage(p)
+        except OSError:
+            continue
+        total += du.total
+        used += du.used
+    return {"total_space": total, "used_space": used} if seen else {}
+
+
 class _Daemon:
     """Common lifecycle: background threads registered for stop()."""
 
@@ -366,6 +395,7 @@ class MetaNodeDaemon(_Daemon):
                               snapshot_every=512)
         self.metanode = MetaNode(self.node_id, self.raft)
         self.zone = cfg.get("zone", "")
+        self.data_dir = cfg.get("walDir")  # None = no space report
         host, port = _addr_split(cfg.get("listen", "127.0.0.1:0"))
         self.service = MetaService(self.metanode, host=host, port=port)
         self.addr = _advertise(self.service.addr, cfg)
@@ -447,7 +477,7 @@ class MetaNodeDaemon(_Daemon):
                    for pid, sm in list(self.metanode.partitions.items())}
         try:
             self.mc.heartbeat(self.node_id, partitions=len(cursors),
-                              cursors=cursors)
+                              cursors=cursors, **_space_report(self.data_dir))
         except MasterError:  # "unknown node": master lost state → re-register
             self._register()
         _resolve_raft_peers(self.mc, self.net)
@@ -516,6 +546,7 @@ class DataNodeDaemon(_Daemon):
         self.datanode = DataNode(self.node_id, cfg.get("listen", "127.0.0.1:0"),
                                  cfg["disks"], raft=self.raft)
         self.zone = cfg.get("zone", "")
+        self.data_dir = list(cfg["disks"])  # all roots, deduped by fs
         self.datanode.start()
         self.addr = _advertise(self.datanode.addr, cfg)
         self.mc = MasterClient(cfg["masterAddrs"],
@@ -539,7 +570,8 @@ class DataNodeDaemon(_Daemon):
 
         pids = {pid: 0 for pid in list(self.datanode.space.partitions)}
         try:
-            self.mc.heartbeat(self.node_id, partitions=len(pids), cursors=pids)
+            self.mc.heartbeat(self.node_id, partitions=len(pids), cursors=pids,
+                              **_space_report(self.data_dir))
         except MasterError:
             self._register()
         _resolve_raft_peers(self.mc, self.net)

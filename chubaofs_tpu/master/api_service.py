@@ -62,6 +62,7 @@ class MasterAPI:
         r = Router()
         g = r.get
         g("/admin/getCluster", self._w(self.get_cluster, leader=False))
+        g("/admin/getClusterStat", self._w(self.get_cluster_stat, leader=False))
         g("/admin/getTopology", self._w(self.get_topology, leader=False))
         g("/admin/getIp", self._w(self.get_ip, leader=False))
         g("/admin/createVol", self._w(self.create_vol, admin=True))
@@ -136,6 +137,10 @@ class MasterAPI:
             "volumes": sorted(sm.volumes),
             "users": sorted(sm.users),
         }
+
+    def get_cluster_stat(self, req: Request):
+        """Space/health rollup (ref /admin/getClusterStat, statinfo loop)."""
+        return self.master.cluster_stat()
 
     def get_topology(self, req: Request):
         """zones -> nodesets -> node ids (master/topology.go view); the ONE
@@ -214,9 +219,13 @@ class MasterAPI:
         # "{}" = an explicit empty report that WIPES the node's cursor set
         raw = req.q("cursors", "")
         cursors = json.loads(raw) if raw else None
+        total = req.q("total_space", "")
+        used = req.q("used_space", "")
         self.master.heartbeat(int(req.q("id")),
                               partition_count=int(req.q("partitions", "0")),
-                              cursors=cursors)
+                              cursors=cursors,
+                              total_space=int(total) if total else None,
+                              used_space=int(used) if used else None)
         return None
 
     def decommission_meta(self, req: Request):
@@ -399,12 +408,19 @@ class MasterClient:
         return self.call(self._path(f"/{which}/add", id=node_id, addr=addr,
                                     raftAddr=raft_addr, zone=zone))
 
-    def heartbeat(self, node_id: int, partitions: int = 0, cursors: dict | None = None):
+    def heartbeat(self, node_id: int, partitions: int = 0,
+                  cursors: dict | None = None,
+                  total_space: int | None = None,
+                  used_space: int | None = None):
         import json
 
         return self.call(self._path(
             "/node/heartbeat", id=node_id, partitions=partitions,
-            cursors=None if cursors is None else json.dumps(cursors)))
+            cursors=None if cursors is None else json.dumps(cursors),
+            total_space=total_space, used_space=used_space))
+
+    def cluster_stat(self):
+        return self.call("/admin/getClusterStat")
 
     def create_user(self, user: str, user_type: str = "normal"):
         return self.call(self._path("/user/create", user=user, type=user_type))

@@ -43,6 +43,8 @@ class NodeInfo:
     status: str = "active"  # active | decommissioned
     zone: str = ""  # fault domain (master/topology.go:43 zones)
     nodeset: int = 0  # zone-local nodeset index (bounded failure groups)
+    total_space: int = 0  # bytes, node-reported via heartbeat (statinfo)
+    used_space: int = 0
 
     @property
     def schedulable(self) -> bool:
@@ -211,7 +213,9 @@ class MasterSM(StateMachine):
         return ns
 
     def _op_heartbeat(self, node_id: int, partition_count: int = 0,
-                      cursors: dict | None = None, now: float = 0.0):
+                      cursors: dict | None = None, now: float = 0.0,
+                      total_space: int | None = None,
+                      used_space: int | None = None):
         n = self.nodes.get(node_id)
         if n is None:
             raise MasterError(f"unknown node {node_id}")
@@ -219,6 +223,12 @@ class MasterSM(StateMachine):
         if n.status == "inactive":
             n.status = "active"  # liveness recovery; decommissioned stays out
         n.partition_count = partition_count
+        # space report (statinfo source, master/cluster.go UpdateStatInfo):
+        # None = no report, leaves state alone
+        if total_space is not None:
+            n.total_space = int(total_space)
+        if used_space is not None:
+            n.used_space = int(used_space)
         # a dict REPLACES the cursor set (even when empty — a restarted node
         # reports no partitions, and the ensure sweep must see that to re-send
         # create tasks); None means "no report" and leaves state alone
@@ -439,12 +449,41 @@ class Master:
                 ids.sort()
         return out
 
-    def heartbeat(self, node_id: int, partition_count: int = 0, cursors: dict | None = None):
+    def heartbeat(self, node_id: int, partition_count: int = 0,
+                  cursors: dict | None = None,
+                  total_space: int | None = None,
+                  used_space: int | None = None):
         # a returning node may receive new placements again, so the dead-node
         # sweep must re-examine it if it dies a second time
         self._dead_drained.discard(node_id)
         self._apply("heartbeat", node_id=node_id, partition_count=partition_count,
-                    cursors=cursors, now=time.time())
+                    cursors=cursors, now=time.time(),
+                    total_space=total_space, used_space=used_space)
+
+    def cluster_stat(self) -> dict:
+        """Cluster/zone space + health rollup from node heartbeat reports.
+
+        Reference: Cluster.scheduleToUpdateStatInfo (master/cluster.go:335)
+        maintains this in a ticker; here the rollup derives on read — the
+        node table is small and raft-replicated, so a loop would only add
+        staleness."""
+        zones: dict[str, dict] = {}
+        total = {"total_space": 0, "used_space": 0, "nodes": 0, "active": 0,
+                 "meta_partitions": 0, "data_partitions": 0}
+        for n in self.sm.nodes.values():
+            z = zones.setdefault(n.zone, {"total_space": 0, "used_space": 0,
+                                          "nodes": 0, "active": 0})
+            for agg in (z, total):
+                agg["total_space"] += n.total_space
+                agg["used_space"] += n.used_space
+                agg["nodes"] += 1
+                agg["active"] += 1 if n.status == "active" else 0
+        for vol in self.sm.volumes.values():
+            total["meta_partitions"] += len(vol.meta_partitions)
+            total["data_partitions"] += len(vol.data_partitions)
+        total["volumes"] = len(self.sm.volumes)
+        total["zones"] = zones
+        return total
 
     # -- volume admin -----------------------------------------------------------
 

@@ -10,7 +10,7 @@ import os
 import numpy as np
 import pytest
 
-from chubaofs_tpu.blobstore.blobnode import HEADER_LEN, BlobNode
+from chubaofs_tpu.blobstore.blobnode import BlobNode
 from chubaofs_tpu.blobstore.cluster import MiniCluster
 from chubaofs_tpu.blobstore.taskswitch import SWITCH_VOL_INSPECT
 

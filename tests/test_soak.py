@@ -13,7 +13,6 @@ Invariants checked continuously:
     disk still backs any volume unit.
 """
 
-import os
 import random
 
 import numpy as np

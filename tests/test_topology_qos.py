@@ -496,3 +496,24 @@ def test_liveness_leaves_decommissioned_alone(master):
     # and a (buggy/stray) heartbeat must NOT resurrect it into placement
     master.heartbeat(victim)
     assert master.sm.nodes[victim].status == "decommissioned"
+
+
+def test_cluster_stat_rollup(master):
+    """Space/health rollup from heartbeat reports (scheduleToUpdateStatInfo +
+    /admin/getClusterStat analog), per zone and cluster-wide."""
+    _register_grid(master, "meta", zones=2, per_zone=1, base=100)
+    _register_grid(master, "data", zones=2, per_zone=1, base=200)
+    master.heartbeat(100, total_space=1000, used_space=250)
+    master.heartbeat(200, total_space=2000, used_space=500)
+    master.heartbeat(201, total_space=4000)  # partial report: used unchanged
+
+    st = master.cluster_stat()
+    assert st["total_space"] == 7000 and st["used_space"] == 750
+    assert st["nodes"] == 4 and st["active"] == 4
+    assert st["zones"]["z0"]["total_space"] == 3000
+    assert st["zones"]["z1"]["total_space"] == 4000
+    assert st["volumes"] == 0 and st["meta_partitions"] == 0
+
+    # a repeat heartbeat without a space report leaves the numbers alone
+    master.heartbeat(100)
+    assert master.cluster_stat()["total_space"] == 7000
