@@ -205,6 +205,15 @@ class Access:
         default_registry().counter(
             "access_disk_punish", {"reason": reason or "error"}).add()
 
+    def clear_punishments(self) -> None:
+        """Drop every active punish window (ops lever): once an AZ/host
+        recovery is CONFIRMED, writes may trust it again immediately instead
+        of waiting out punish_secs — otherwise a second failure inside the
+        window sees the healed AZ as still dark and blobs land with two AZs'
+        worth of shards missing."""
+        with self._punish_lock:
+            self._punished.clear()
+
     # -- location signing ----------------------------------------------------
 
     def _sign(self, loc: Location) -> str:
@@ -424,6 +433,42 @@ class Access:
             return b"".join(pieces)
         return self._read_blob_degraded(t, vol, blob, shard_len, offset, size)
 
+    def _recover_locals_inplace(self, t, vol, blob, stripe, present: list,
+                                shard_len: int) -> None:
+        """Repair missing GLOBAL shards via their AZ-local stripes, updating
+        stripe/present in place. Each AZ is independent: damage within an
+        AZ's local-parity budget is fixed reading ONLY that AZ's shards."""
+        pres = set(present)
+        for idx_list, local_n, local_m in t.local_stripes():
+            globals_in_az = [g for g in idx_list if g < t.N + t.M]
+            recoverable = [g for g in globals_in_az if g not in pres]
+            if not recoverable:
+                continue  # nothing this AZ's stripe could win back
+            locals_in_az = [g for g in idx_list if g >= t.N + t.M]
+            az_reads: dict[int, np.ndarray] = {
+                g: stripe[g] for g in globals_in_az if g in pres
+            }
+            # local parities live outside the global gather; fetch them
+            # concurrently — this runs on the latency-critical degraded path
+            for g, data in zip(locals_in_az, self._read_pool.map(
+                    lambda g: self._read_shard(vol, g, blob.bid, 0, shard_len),
+                    locals_in_az)):
+                if data is not None:
+                    az_reads[g] = np.frombuffer(data, np.uint8)
+            az_bad = [g for g in idx_list if g not in az_reads]
+            if len(az_bad) > local_m:
+                continue
+            sub = np.zeros((len(idx_list), shard_len), np.uint8)
+            pos = {g: p for p, g in enumerate(idx_list)}
+            for g, d in az_reads.items():
+                sub[pos[g]] = d
+            fixed = self.codec.reconstruct(
+                local_n, local_m, sub, [pos[g] for g in az_bad]
+            ).result()
+            for g in recoverable:
+                stripe[g] = fixed[pos[g]]
+                present.append(g)
+
     def _read_shard(
         self, vol: VolumeInfo, idx: int, bid: int, offset: int, size: int
     ) -> bytes | None:
@@ -441,7 +486,13 @@ class Access:
 
     def _read_blob_degraded(self, t, vol, blob, shard_len, offset, size) -> bytes:
         """Full-stripe gather + on-the-fly repair of missing data shards
-        (stream_get.go:427 ReconstructData fallback)."""
+        (stream_get.go:427 ReconstructData fallback). When the global stripe
+        alone can't reach N survivors and the mode carries local parities,
+        AZ-local stripes are tried first (work_shard_recover.go:517
+        recoverByLocalStripe applied at READ time) — e.g. one dark AZ plus a
+        corrupt shard elsewhere exceeds M globally but the corrupt shard's own
+        AZ can still repair it locally. Read-only: durable healing stays with
+        the repair plane via the shard-repair topic."""
         stripe = np.zeros((t.N + t.M, shard_len), np.uint8)
         present = []
         reads = list(self._read_pool.map(
@@ -451,13 +502,19 @@ class Access:
             if data is not None:
                 stripe[idx] = np.frombuffer(data, np.uint8)
                 present.append(idx)
+        # the repair plane must hear about EVERYTHING the gather proved
+        # damaged — including shards the local-stripe pass then fixes only
+        # in memory (they are still broken on disk)
+        damaged = [i for i in range(t.N + t.M) if i not in present]
+        if len(present) < t.N and getattr(t, "L", 0):
+            self._recover_locals_inplace(t, vol, blob, stripe, present, shard_len)
         missing = [i for i in range(t.N + t.M) if i not in present]
         if len(present) < t.N:
             raise AccessError(
                 f"blob {blob.bid}: only {len(present)} shards readable, need {t.N}"
             )
         fixed = self.codec.reconstruct(t.N, t.M, stripe, missing, data_only=True).result()
-        self.proxy.send_shard_repair(vol.vid, blob.bid, missing, "get_miss")
+        self.proxy.send_shard_repair(vol.vid, blob.bid, damaged, "get_miss")
         data_region = fixed[: t.N].reshape(-1)
         return data_region[offset : offset + size].tobytes()
 

@@ -98,6 +98,33 @@ class Scheduler:
     _TASK_PREFIX = "task/"
     _TASK_SEQ_KEY = "task_seq"
 
+    # in-memory history cap: terminal tasks already left the KV
+    # (_persist_task) and the recordlog holds the durable audit; keeping a
+    # bounded tail serves `task ls` without letting a long outage — where
+    # FAILED tasks are re-created per fresh damage report — grow the table,
+    # and with it task ids and memory, without bound
+    TERMINAL_KEEP = 256
+
+    def _prune_terminal_locked(self) -> None:
+        terminal = [t for t in self._tasks.values()
+                    if t.state in (TASK_FINISHED, TASK_FAILED)]
+        if len(terminal) <= self.TERMINAL_KEEP:
+            return
+        terminal.sort(key=lambda t: int(t.task_id.lstrip("t") or 0))
+        for t in terminal[: len(terminal) - self.TERMINAL_KEEP]:
+            del self._tasks[t.task_id]
+
+    def _has_tombstone(self, node_id: int, vuid: int, bid: int) -> bool:
+        """Tombstone probe that tolerates dark hosts: an unreachable node
+        simply cannot attest a tombstone (the sweep retries next round)."""
+        node = self.nodes.get(node_id)
+        if node is None:
+            return False
+        try:
+            return bool(node.has_tombstone(vuid, bid))
+        except Exception:
+            return False
+
     def _load_tasks(self):
         """Reload open tasks after a restart; WORKING tasks re-queue (their
         worker died with us — the reference's junk-task cleanup re-drives).
@@ -153,10 +180,15 @@ class Scheduler:
         topic = self.proxy.topics[TOPIC_SHARD_REPAIR]
         msgs = topic.consume("scheduler", max_msgs)
         with self._lock:
+            # terminal tasks don't block a fresh attempt: a FAILED task means
+            # retries ran out under the conditions of the time (e.g. a dark
+            # AZ); the damage persisting past that deserves a new task, not
+            # permanent abandonment (TASK_FAILED is "eligible for re-creation")
             open_keys = {
                 (t.vid, t.bid)
                 for t in self._tasks.values()
-                if t.kind == KIND_SHARD_REPAIR and t.state != TASK_FINISHED
+                if t.kind == KIND_SHARD_REPAIR
+                and t.state not in (TASK_FINISHED, TASK_FAILED)
             }
         for m in msgs:
             key = (m["vid"], m["bid"])
@@ -232,8 +264,7 @@ class Scheduler:
                 # resurrecting it — checked BEFORE the mark-delete skip so a
                 # half-marked straggler can't wedge forever
                 tombstoned = any(
-                    self.nodes.get(u.node_id) is not None
-                    and self.nodes[u.node_id].has_tombstone(u.vuid, bid)
+                    self._has_tombstone(u.node_id, u.vuid, bid)
                     for u in vol.units
                 )
                 if tombstoned:
@@ -347,6 +378,8 @@ class Scheduler:
                 t.error = error
                 t.state = TASK_PREPARED if t.retries < 3 else TASK_FAILED
             self._persist_task(t)
+            if t.state in (TASK_FINISHED, TASK_FAILED):
+                self._prune_terminal_locked()
             record = None
             if self.record_log is not None and t.state in (TASK_FINISHED, TASK_FAILED):
                 record = {

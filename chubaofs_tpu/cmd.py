@@ -121,10 +121,11 @@ def _space_report(paths) -> dict:
             dev = _os.stat(p).st_dev
             if dev in seen:
                 continue
-            seen.add(dev)
             du = shutil.disk_usage(p)
         except OSError:
             continue
+        seen.add(dev)  # only after BOTH calls succeed: a stat-ok but
+        # statvfs-failing mount must not turn the report into zeros
         total += du.total
         used += du.used
     return {"total_space": total, "used_space": used} if seen else {}
