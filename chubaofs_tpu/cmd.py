@@ -174,6 +174,10 @@ class MasterDaemon(_Daemon):
         self.node_id = int(cfg["id"])
         raft_peers = {int(k): v for k, v in cfg["raftPeers"].items()}
         self.peer_apis = {int(k): v for k, v in cfg.get("peerApis", {}).items()}
+        # how long a node must stay dead before its replicas auto-re-home
+        # (deadNodeSecs in config; tests compress it)
+        self.dead_node_secs = float(cfg.get("deadNodeSecs",
+                                            60 * HEARTBEAT_INTERVAL))
         self.net = _make_net(self.node_id, raft_peers, cfg)
         self.raft = MultiRaft(self.node_id, self.net, wal_dir=cfg.get("walDir"),
                               snapshot_every=512)
@@ -354,7 +358,7 @@ class MasterDaemon(_Daemon):
         self.master.check_node_liveness(timeout=10 * HEARTBEAT_INTERVAL)
         self.master.check_data_partitions()
         # durable repair: replicas on long-dead nodes re-home to healthy peers
-        self.master.check_dead_node_replicas(dead_after=60 * HEARTBEAT_INTERVAL)
+        self.master.check_dead_node_replicas(dead_after=self.dead_node_secs)
         now = time.time()
         for vol in list(self.sm.volumes.values()):
             for mp in vol.meta_partitions:

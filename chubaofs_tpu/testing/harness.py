@@ -46,7 +46,8 @@ class ProcCluster:
 
     def __init__(self, root: str, masters: int = 3, metanodes: int = 3,
                  datanodes: int = 3, blobstore: bool = False,
-                 objectnode: bool = False, env: dict | None = None):
+                 objectnode: bool = False, env: dict | None = None,
+                 master_extra: dict | None = None):
         shell = ProcCluster.shell(root, env)
         self.root = shell.root
         self.env = shell.env
@@ -63,6 +64,7 @@ class ProcCluster:
                 "role": "master", "id": i, "raftPeers": raft_peers,
                 "peerApis": peer_apis, "listen": peer_apis[str(i)],
                 "walDir": os.path.join(root, f"m{i}"),
+                **(master_extra or {}),
             })
         self._await_leader()
 

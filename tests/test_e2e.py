@@ -377,3 +377,59 @@ def test_ticket_gated_cluster_over_daemons(tmp_path):
                          admin_ticket=node_prov).delete_volume("tgvol")
     finally:
         shell.close()
+
+
+@pytest.mark.slow
+def test_dead_datanode_auto_rehome_over_daemons(tmp_path):
+    """SIGKILL a datanode and do NOT bring it back: the master's liveness +
+    dead-node sweep re-homes its replicas onto the spare daemon without any
+    operator action (scheduleToCheckDataReplicas analog, end to end), and the
+    volume heals back to rw with the data still readable."""
+    c = ProcCluster(str(tmp_path), masters=1, metanodes=3, datanodes=4,
+                    master_extra={"deadNodeSecs": 3})
+    try:
+        mc = c.client_master()
+        mc.create_volume("arh", cold=False)
+        fs = c.fs("arh")
+        fs.write_file("/precious.txt", b"survives the dead node")
+
+        views = mc.data_partitions("arh")
+        assert views, "no rw data partitions"
+        victim_nid = views[0]["peers"][0]
+        victim_name = f"datanode{victim_nid}"
+        assert victim_name in c.procs
+        c.kill(victim_name)
+
+        # liveness (10 * HEARTBEAT) + deadNodeSecs + ensure tick; generous cap.
+        # Success reads the FULL admin table, not the rw-only client view —
+        # a stuck migration leaves the victim's partitions demoted+hidden,
+        # which must fail this check, not slip past it.
+        deadline = time.time() + 90
+        rehomed = False
+        while time.time() < deadline:
+            try:
+                dps = mc.get_volume("arh")["data_partitions"]
+                if dps and all(victim_nid not in dp["peers"]
+                               and len(dp["peers"]) == 3
+                               and dp["status"] == "rw" for dp in dps):
+                    rehomed = True
+                    break
+            except Exception:
+                pass
+            time.sleep(1)
+        assert rehomed, f"replicas still on dead node {victim_nid}"
+
+        # the re-homed volume serves reads AND writes
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                assert c.fs("arh").read_file("/precious.txt") == (
+                    b"survives the dead node")
+                c.fs("arh").write_file("/after.txt", b"rw again")
+                break
+            except Exception:
+                time.sleep(1)
+        else:
+            raise AssertionError("volume not serving after re-home")
+    finally:
+        c.close()
