@@ -211,12 +211,32 @@ class GraphQLAPI:
     def _user_info(self, args):
         return self._user_dict(self.master.get_user(self._arg(args, "userID")))
 
+    def _cluster_stat(self, args):
+        """Space/health rollup (the dashboard's capacity tiles; ref
+        /admin/getClusterStat) — camelCased like every other root field,
+        zones as a selectable list."""
+        st = self.master.cluster_stat()
+        return {
+            "totalSpace": st["total_space"], "usedSpace": st["used_space"],
+            "nodes": st["nodes"], "active": st["active"],
+            "volumes": st["volumes"],
+            "metaPartitions": st["meta_partitions"],
+            "dataPartitions": st["data_partitions"],
+            "zones": [
+                {"name": z, "totalSpace": v["total_space"],
+                 "usedSpace": v["used_space"], "nodes": v["nodes"],
+                 "active": v["active"]}
+                for z, v in sorted(st["zones"].items())
+            ],
+        }
+
     ROOTS = {
         "clusterView": _cluster_view,
         "volumeList": _volume_list,
         "volume": _volume,
         "userList": _user_list,
         "userInfo": _user_info,
+        "clusterStat": _cluster_stat,
     }
 
     def execute(self, query: str, variables: dict | None = None) -> dict:

@@ -159,6 +159,12 @@ def test_graphql_queries(cluster):
     # UTF-8 string literals survive (no unicode_escape mojibake)
     with pytest.raises(Exception, match="café"):
         api.execute('{ volume(name: "café") { name } }')
+    # clusterStat: the dashboard capacity rollup rides the same endpoint
+    data = api.execute(
+        "{ clusterStat { nodes active volumes totalSpace zones { name nodes } } }")
+    assert data["clusterStat"]["nodes"] >= 1
+    assert data["clusterStat"]["volumes"] >= 1
+    assert isinstance(data["clusterStat"]["zones"], list)
     # missing required argument is a GraphQL error, not a 500
     with pytest.raises(GQLError):
         api.execute("{ volume { name } }")
