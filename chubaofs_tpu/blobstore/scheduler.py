@@ -36,7 +36,6 @@ from chubaofs_tpu.blobstore.proxy import (
     TOPIC_SHARD_REPAIR,
     Proxy,
 )
-from chubaofs_tpu.codec.codemode import get_tactic
 from chubaofs_tpu.codec.service import CodecService, default_service
 from chubaofs_tpu.utils.exporter import default_registry
 

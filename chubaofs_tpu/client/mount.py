@@ -16,7 +16,6 @@ into, so the kernel shim stays a thin add-on.
 from __future__ import annotations
 
 import os
-import stat as stat_mod
 import threading
 import time
 

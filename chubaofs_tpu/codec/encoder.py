@@ -22,7 +22,7 @@ The list-of-buffers API is kept for drop-in familiarity: a user of the reference
 from __future__ import annotations
 
 import functools
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import IO, Sequence
 
 import numpy as np

@@ -3,7 +3,8 @@
 Reference counterpart: master/gapi_cluster.go, gapi_volume.go, gapi_user.go —
 the console's query surface. Kept: a POST /graphql endpoint taking
 {"query": "...", "variables": {...}} and the reference's root fields
-(clusterView, volumeList, volume(name), userList, userInfo(userID)).
+(clusterView, clusterStat, volumeList, volume(name), userList,
+userInfo(userID)).
 Changed: a purpose-built micro-parser for the query subset the console
 emits — field selection with scalar arguments and nested selection sets —
 instead of a full GraphQL implementation; unknown syntax is rejected.

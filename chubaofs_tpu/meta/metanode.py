@@ -11,7 +11,7 @@ from __future__ import annotations
 import threading
 from concurrent.futures import Future
 
-from chubaofs_tpu.meta.partition import MetaError, MetaPartitionSM, NoEntry
+from chubaofs_tpu.meta.partition import MetaError, MetaPartitionSM
 from chubaofs_tpu.raft.server import MultiRaft, NotLeaderError
 
 

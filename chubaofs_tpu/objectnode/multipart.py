@@ -13,7 +13,6 @@ too.
 from __future__ import annotations
 
 import hashlib
-import json
 
 from chubaofs_tpu.meta.metanode import OpError
 from chubaofs_tpu.objectnode.volume import (

@@ -9,7 +9,6 @@ serve later reads locally, a byte/file budget, and a summary report.
 from __future__ import annotations
 
 import concurrent.futures as futures
-import stat as stat_mod
 from dataclasses import dataclass
 
 from chubaofs_tpu.sdk.fs import FsClient, FsError
