@@ -379,7 +379,6 @@ def test_ticket_gated_cluster_over_daemons(tmp_path):
         shell.close()
 
 
-@pytest.mark.slow
 def test_dead_datanode_auto_rehome_over_daemons(tmp_path):
     """SIGKILL a datanode and do NOT bring it back: the master's liveness +
     dead-node sweep re-homes its replicas onto the spare daemon without any
@@ -433,3 +432,41 @@ def test_dead_datanode_auto_rehome_over_daemons(tmp_path):
             raise AssertionError("volume not serving after re-home")
     finally:
         c.close()
+
+
+def test_overlapping_mounts_consistency(cluster):
+    """regression/overlapping analog (ref regression/overlapping/main.go:22-30):
+    two mounts of one volume interleave writes+fsyncs over the SAME byte
+    range; after each sync the other mount observes the writer's bytes, and
+    the final layout reads identically through both mounts."""
+    off = 1 * 1024 * 1024  # past the tiny-extent region, like the reference
+    a = b"mount-one-payload-" * 64
+    b_ = b"MOUNT-TWO-payload-" * 64
+    L = len(a)
+    assert len(b_) == L
+
+    m1 = Mount(cluster.fs("posix"), volume="posix")
+    m2 = Mount(cluster.fs("posix"), volume="posix")
+    fd1 = m1.open("/overlap.bin", O_RDWR | O_CREAT)
+    fd2 = m2.open("/overlap.bin", O_RDWR)
+
+    # m1 writes A at off, syncs; m2 must see it
+    m1.write(fd1, a, offset=off)
+    m1.fsync(fd1)
+    assert m2.read(fd2, L, offset=off) == a
+
+    # m2 overwrites with B twice (off, off+L), syncs; m1 must see both
+    m2.write(fd2, b_, offset=off)
+    m2.write(fd2, b_, offset=off + L)
+    m2.fsync(fd2)
+    assert m1.read(fd1, L, offset=off) == b_
+    assert m1.read(fd1, L, offset=off + L) == b_
+
+    # m1 overwrites the second region back to A; final layout = [B, A]
+    m1.write(fd1, a, offset=off + L)
+    m1.fsync(fd1)
+    for m, fd in ((m1, fd1), (m2, fd2)):
+        assert m.read(fd, L, offset=off) == b_
+        assert m.read(fd, L, offset=off + L) == a
+    m1.close(fd1)
+    m2.close(fd2)
