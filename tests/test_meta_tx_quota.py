@@ -41,6 +41,28 @@ def test_uniq_window_prunes():
     assert len(sm.uniq_seen["c1"]) == sm.UNIQ_WINDOW
 
 
+def test_uniq_duplicate_append_extents_no_conflict():
+    """regression/idempotent analog: the metanode applies AppendExtentKey,
+    the reply is lost, the client RETRIES the identical request — the replay
+    must return the recorded result, not append the extents a second time
+    (the reference's fix made AppendExtentKeyWithCheck idempotent)."""
+    sm = mk_sm()
+    ino = sm.apply(("create_inode", {"mode": stat.S_IFREG | 0o644}), 1)[1].ino
+    args = {"ino": ino, "size": 4096, "_uniq": ("c1", 42),
+            "extents": [{"partition_id": 7, "extent_id": 3,
+                         "file_offset": 0, "extent_offset": 0, "size": 4096}]}
+    r1 = sm.apply(("append_extents", args), 2)
+    # snapshot observable state BEFORE the retry: both results wrap the same
+    # live Inode object, so comparing r1 == r2 alone would be vacuous
+    extents_after_first = len(sm.inodes[ino].extents)
+    r2 = sm.apply(("append_extents", args), 3)  # network-failure retry
+    assert r1[0] == "ok" and r2[0] == "ok"
+    inode = sm.inodes[ino]
+    assert extents_after_first == 1
+    assert len(inode.extents) == 1, "duplicate delivery appended twice"
+    assert inode.size == 4096
+
+
 # -- 2PC transactions (SM level) -----------------------------------------------
 
 
