@@ -44,8 +44,18 @@ def log(*a):
     print(*a, file=sys.stderr, flush=True)
 
 
-def throughput(fn, args, n1=10, n2=40, runs=3) -> float:
-    """Seconds per call via slope timing (see module docstring)."""
+def throughput(fn, args, n1=10, n2=40, runs=3, passes=3,
+               floor: float = 0.0) -> float:
+    """Seconds per call via slope timing (see module docstring).
+
+    Median across ``passes`` passes, each itself a median-of-``runs`` slope —
+    robust to the proxied chip's co-tenant load drift without the low-tail
+    bias a min-of-samples would introduce (an extreme statistic would crown
+    exactly the corrupted deflated slopes the medians exist to reject).
+    ``floor`` is the physical lower bound on seconds-per-call (HBM peak):
+    sub-floor passes are corrupted measurements (both legs raced the same
+    stall) and are discarded; if NOTHING plausible remains the run errors out
+    with the raw slopes rather than printing impossible numbers."""
 
     def timed(iters: int) -> float:
         t0 = time.perf_counter()
@@ -56,13 +66,25 @@ def throughput(fn, args, n1=10, n2=40, runs=3) -> float:
         return time.perf_counter() - t0
 
     timed(2)  # compile + warm
-    # median of the deltas: a single stall in either leg must not deflate the
-    # subtraction (min-of-deltas would lock in a corrupted, even negative, run)
-    deltas = sorted(timed(n2) - timed(n1) for _ in range(runs))
-    per_iter = deltas[len(deltas) // 2] / (n2 - n1)
-    if per_iter <= 0:
-        raise RuntimeError(f"unstable timing: deltas={deltas}")
-    return per_iter
+    plausible: list[float] = []
+    raw: list[float] = []
+    for _ in range(passes):
+        # median of the deltas: a single stall in either leg must not deflate
+        # the subtraction (min-of-deltas would lock in a corrupted run)
+        deltas = sorted(timed(n2) - timed(n1) for _ in range(runs))
+        per_iter = deltas[len(deltas) // 2] / (n2 - n1)
+        raw.append(per_iter)
+        if per_iter >= max(floor, 0.0) and per_iter > 0:
+            plausible.append(per_iter)
+    if not plausible:
+        raise RuntimeError(f"unstable timing: no plausible pass; slopes={raw}")
+    plausible.sort()
+    return plausible[len(plausible) // 2]
+
+
+def hbm_floor(total_bytes_moved: int) -> float:
+    """Physical seconds floor: HBM traffic at the v5e peak (~819 GB/s)."""
+    return total_bytes_moved / 819e9
 
 
 def stage_grouped(dev, host, mat_bits):
@@ -85,7 +107,8 @@ def bench_encode(rng, dev, n, m, stripe_bytes, batch) -> float:
     host = rng.integers(0, 256, (batch, n, k), dtype=np.uint8)
     mat_s, data = stage_grouped(dev, host, kernel.parity_bits)
     # the numpy matrix closed over bakes in as a compile-time constant
-    per = throughput(jax.jit(lambda s: rs.gf_matmul_dispatch(mat_s, s)), (data,))
+    per = throughput(jax.jit(lambda s: rs.gf_matmul_dispatch(mat_s, s)), (data,),
+                     floor=hbm_floor(batch * (n + m) * k))
     return batch * n * k / per / 1e9
 
 
@@ -98,7 +121,8 @@ def bench_reconstruct(rng, dev, n, m, stripe_bytes, batch, missing) -> tuple[flo
     data = rng.integers(0, 256, (batch, n, k), dtype=np.uint8)
     stripe = np.asarray(jax.jit(kernel.encode)(jax.device_put(jnp.asarray(data), dev)))
     mat_s, survivors = stage_grouped(dev, stripe[:, present, :], mat_bits)
-    per = throughput(jax.jit(lambda s: rs.gf_matmul_dispatch(mat_s, s)), (survivors,))
+    per = throughput(jax.jit(lambda s: rs.gf_matmul_dispatch(mat_s, s)), (survivors,),
+                     floor=hbm_floor(batch * (n + len(missing)) * k))
     return batch * n * k / per / 1e9, batch / per
 
 
@@ -114,7 +138,8 @@ def bench_lrc_encode(rng, dev, stripe_bytes, batch) -> float:
     mat_bits = bitmatrix.expand_matrix(lrc_parity_matrix(t)).astype(np.int8)
     host = rng.integers(0, 256, (batch, t.N, k), dtype=np.uint8)
     mat_s, data = stage_grouped(dev, host, mat_bits)
-    per = throughput(jax.jit(lambda s: rs.gf_matmul_dispatch(mat_s, s)), (data,))
+    per = throughput(jax.jit(lambda s: rs.gf_matmul_dispatch(mat_s, s)), (data,),
+                     floor=hbm_floor(batch * (t.N + t.M + t.L) * k))
     return batch * t.N * k / per / 1e9
 
 
