@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from chubaofs_tpu.codec.codemode import Tactic
+from chubaofs_tpu.codec.codemode import CodeMode, get_tactic
 from chubaofs_tpu.ops import rs
 
 TARGET_GBPS = 40.0
@@ -82,9 +82,25 @@ def throughput(fn, args, n1=10, n2=40, runs=3, passes=3,
     return plausible[len(plausible) // 2]
 
 
-def hbm_floor(total_bytes_moved: int) -> float:
-    """Physical seconds floor: HBM traffic at the v5e peak (~819 GB/s)."""
-    return total_bytes_moved / 819e9
+def hbm_peak(dev) -> float:
+    """HBM peak bytes/sec for the device the bench actually runs on; unknown
+    kinds get no plausibility gate (inf) rather than spurious rejections."""
+    kind = (getattr(dev, "device_kind", "") or "").lower()
+    if "v5 lite" in kind or "v5e" in kind:
+        return 819e9
+    if "v6 lite" in kind or "v6e" in kind:
+        return 1640e9
+    if "v5p" in kind:
+        return 2765e9
+    if "v4" in kind:
+        return 1228e9
+    return float("inf")
+
+
+def hbm_floor(total_bytes_moved: int, dev) -> float:
+    """Physical seconds floor: moving the op's bytes at the device's HBM peak."""
+    peak = hbm_peak(dev)
+    return 0.0 if peak == float("inf") else total_bytes_moved / peak
 
 
 def stage_grouped(dev, host, mat_bits):
@@ -108,7 +124,7 @@ def bench_encode(rng, dev, n, m, stripe_bytes, batch) -> float:
     mat_s, data = stage_grouped(dev, host, kernel.parity_bits)
     # the numpy matrix closed over bakes in as a compile-time constant
     per = throughput(jax.jit(lambda s: rs.gf_matmul_dispatch(mat_s, s)), (data,),
-                     floor=hbm_floor(batch * (n + m) * k))
+                     floor=hbm_floor(batch * (n + m) * k, dev))
     return batch * n * k / per / 1e9
 
 
@@ -122,7 +138,7 @@ def bench_reconstruct(rng, dev, n, m, stripe_bytes, batch, missing) -> tuple[flo
     stripe = np.asarray(jax.jit(kernel.encode)(jax.device_put(jnp.asarray(data), dev)))
     mat_s, survivors = stage_grouped(dev, stripe[:, present, :], mat_bits)
     per = throughput(jax.jit(lambda s: rs.gf_matmul_dispatch(mat_s, s)), (survivors,),
-                     floor=hbm_floor(batch * (n + len(missing)) * k))
+                     floor=hbm_floor(batch * (n + len(missing)) * k, dev))
     return batch * n * k / per / 1e9, batch / per
 
 
@@ -133,13 +149,13 @@ def bench_lrc_encode(rng, dev, stripe_bytes, batch) -> float:
     from chubaofs_tpu.codec.encoder import lrc_parity_matrix
     from chubaofs_tpu.ops import bitmatrix
 
-    t = Tactic(20, 4, 2, 2, put_quorum=22)
+    t = get_tactic(CodeMode.EC20P4L2)
     k = -(-stripe_bytes // t.N // 128) * 128
     mat_bits = bitmatrix.expand_matrix(lrc_parity_matrix(t)).astype(np.int8)
     host = rng.integers(0, 256, (batch, t.N, k), dtype=np.uint8)
     mat_s, data = stage_grouped(dev, host, mat_bits)
     per = throughput(jax.jit(lambda s: rs.gf_matmul_dispatch(mat_s, s)), (data,),
-                     floor=hbm_floor(batch * (t.N + t.M + t.L) * k))
+                     floor=hbm_floor(batch * (t.N + t.M + t.L) * k, dev))
     return batch * t.N * k / per / 1e9
 
 

@@ -37,6 +37,9 @@ class CodeMode(enum.IntEnum):
     # test-only modes (kept for parity with the reference's table)
     EC6P6L9 = 200
     EC6P8L10 = 201
+    # BASELINE.json archive config (EC(20,4)+LRC local parity, 2 AZ) — shared
+    # by bench.py and the multichip dryrun so the two can never drift
+    EC20P4L2 = 202
 
 
 @dataclass(frozen=True)
@@ -136,6 +139,7 @@ _TACTICS: dict[CodeMode, Tactic] = {
     CodeMode.EC4P4L2: Tactic(4, 4, 2, 2, put_quorum=6),
     CodeMode.EC6P6L9: Tactic(6, 6, 9, 3, put_quorum=11),
     CodeMode.EC6P8L10: Tactic(6, 8, 10, 2, put_quorum=13, min_shard_size=ALIGN_0B),
+    CodeMode.EC20P4L2: Tactic(20, 4, 2, 2, put_quorum=22),
 }
 
 
