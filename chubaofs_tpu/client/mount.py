@@ -312,6 +312,12 @@ class Mount:
     def getxattr(self, path: str, key: str) -> bytes:
         return self._op("getxattr", path, lambda: self.fs.getxattr(path, key))
 
+    def listxattr(self, path: str) -> list[str]:
+        return self._op("listxattr", path, lambda: self.fs.listxattr(path))
+
+    def removexattr(self, path: str, key: str) -> None:
+        self._op("removexattr", path, lambda: self.fs.removexattr(path, key))
+
     def statfs(self) -> dict:
         return {"volume": self.volume, "open_fds": len(self._fds),
                 "orphans": len(self._orphans)}

@@ -113,9 +113,12 @@ def test_posix_battery(cluster):
     assert mnt.read(fd, 100) == b"0123XY"
     mnt.close(fd)
 
-    # xattr (setfattr/getfattr shape)
+    # xattr (setfattr/getfattr/listfattr/removefattr shape)
     mnt.setxattr("/t1", "user.tag", b"v1")
     assert mnt.getxattr("/t1", "user.tag") == b"v1"
+    assert "user.tag" in mnt.listxattr("/t1")
+    mnt.removexattr("/t1", "user.tag")
+    assert "user.tag" not in mnt.listxattr("/t1")
 
     # EBADF discipline
     fd = mnt.open("/t1", O_RDONLY)
