@@ -34,7 +34,6 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from chubaofs_tpu.codec.codemode import CodeMode, get_tactic
 from chubaofs_tpu.ops import rs
 
 TARGET_GBPS = 40.0
@@ -142,15 +141,17 @@ def bench_reconstruct(rng, dev, n, m, stripe_bytes, batch, missing) -> tuple[flo
     return batch * n * k / per / 1e9, batch / per
 
 
-def bench_lrc_encode(rng, dev, stripe_bytes, batch) -> float:
+def bench_lrc_encode(rng, dev, batch) -> float:
     """EC(20,4)+L2 archive config: ALL parity (4 global + 2 per-AZ local) in
     one composed-generator matmul (encoder.lrc_parity_matrix) — the TPU-first
-    replacement for the reference's two-stage global+local encode."""
+    replacement for the reference's two-stage global+local encode. Geometry
+    comes from the model zoo's ARCHIVE entry (shared with the dryrun)."""
     from chubaofs_tpu.codec.encoder import lrc_parity_matrix
+    from chubaofs_tpu.models import ARCHIVE
     from chubaofs_tpu.ops import bitmatrix
 
-    t = get_tactic(CodeMode.EC20P4L2)
-    k = -(-stripe_bytes // t.N // 128) * 128
+    t = ARCHIVE.tactic
+    k = ARCHIVE.shard_len
     mat_bits = bitmatrix.expand_matrix(lrc_parity_matrix(t)).astype(np.int8)
     host = rng.integers(0, 256, (batch, t.N, k), dtype=np.uint8)
     mat_s, data = stage_grouped(dev, host, mat_bits)
@@ -196,7 +197,7 @@ def main() -> None:
     )
 
     cfg["ec20p4l2_encode_16mib_gbps"] = round(
-        bench_lrc_encode(rng, dev, 16 * MiB, batch=8), 3
+        bench_lrc_encode(rng, dev, batch=8), 3
     )
     log(f"EC(20,4)+L2 16MiB encode: {cfg['ec20p4l2_encode_16mib_gbps']} GB/s")
 
