@@ -40,6 +40,8 @@ class CodeMode(enum.IntEnum):
     # BASELINE.json archive config (EC(20,4)+LRC local parity, 2 AZ) — shared
     # by bench.py and the multichip dryrun so the two can never drift
     EC20P4L2 = 202
+    # BASELINE.json unit-bench config (plain RS 4+2, single AZ)
+    EC4P2 = 203
 
 
 @dataclass(frozen=True)
@@ -140,6 +142,7 @@ _TACTICS: dict[CodeMode, Tactic] = {
     CodeMode.EC6P6L9: Tactic(6, 6, 9, 3, put_quorum=11),
     CodeMode.EC6P8L10: Tactic(6, 8, 10, 2, put_quorum=13, min_shard_size=ALIGN_0B),
     CodeMode.EC20P4L2: Tactic(20, 4, 2, 2, put_quorum=22),
+    CodeMode.EC4P2: Tactic(4, 2, 0, 1, put_quorum=5),
 }
 
 

@@ -38,7 +38,7 @@ class CodecModel:
 
 MiB = 1 << 20
 
-EC4P2_1M = CodecModel("ec4p2-1mib", CodeMode.EC4P4L2, 1 * MiB)  # unit-bench scale
+EC4P2_1M = CodecModel("ec4p2-1mib", CodeMode.EC4P2, 1 * MiB)  # unit-bench scale
 EC6P3_4M = CodecModel("ec6p3-4mib", CodeMode.EC6P3, 4 * MiB)  # access PUT streaming
 EC12P4_8M = CodecModel("ec12p4-8mib", CodeMode.EC12P4, 8 * MiB)  # flagship
 EC16P20L2_16M = CodecModel("ec16p20l2-16mib", CodeMode.EC16P20L2, 16 * MiB)  # wide-parity LRC

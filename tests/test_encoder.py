@@ -213,3 +213,51 @@ def test_encode_tactic_service_lrc(rng):
         assert enc.verify(list(stripe))
     finally:
         svc.close()
+
+
+def test_codec_service_concurrent_mixed_load():
+    """Many threads race mixed encode/reconstruct jobs of different shapes
+    through one CodecService: the batcher must group compatible jobs and
+    every future must resolve to oracle-exact results (thread-safety of the
+    queue -> padded-batch -> grouped-device-dispatch pipeline)."""
+    import threading
+
+    from chubaofs_tpu.codec.service import CodecService
+    from chubaofs_tpu.ops import gf256, rs
+
+    svc = CodecService(max_batch=8, max_wait_ms=1.0)
+    errors: list[str] = []
+
+    def worker(seed: int):
+        r = np.random.default_rng(seed)
+        try:
+            for i in range(6):
+                n, m = (6, 3) if (seed + i) % 2 else (4, 2)
+                k = int(r.choice([512, 1024, 1536]))
+                data = r.integers(0, 256, (n, k), dtype=np.uint8)
+                stripe = svc.encode(n, m, data).result(timeout=30)
+                want = gf256.encode_numpy(rs.get_kernel(n, m).gen, data)
+                if not np.array_equal(stripe, want):
+                    errors.append(f"seed {seed} iter {i}: encode mismatch")
+                    return
+                # lose one shard, reconstruct through the service
+                broken = stripe.copy()
+                bad = int(r.integers(0, n + m))
+                broken[bad] = 0
+                fixed = svc.reconstruct(n, m, broken, [bad]).result(timeout=30)
+                if not np.array_equal(fixed, want):
+                    errors.append(f"seed {seed} iter {i}: reconstruct mismatch")
+                    return
+        except Exception as e:  # pragma: no cover - failure reporting
+            errors.append(f"seed {seed}: {type(e).__name__}: {e}")
+
+    try:
+        threads = [threading.Thread(target=worker, args=(s,)) for s in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not any(t.is_alive() for t in threads), "worker deadlocked"
+        assert not errors, errors
+    finally:
+        svc.close()
