@@ -169,6 +169,14 @@ def add_admin_routes(router, cluster, runner: ModuleRunner | None = None):
         cluster.scheduler.switches.set(name, enabled)
         return _json({name: enabled})
 
+    def forgive(req):
+        """Lift all access punish windows after a CONFIRMED recovery
+        (Access.clear_punishments — else writes treat a healed AZ/host as
+        dark until punish_secs expires, and a second failure inside the
+        window leaves blobs missing two AZs' worth of shards)."""
+        cluster.access.clear_punishments()
+        return _json({"forgiven": True})
+
     def modules(req):
         return _json(runner.status() if runner else [])
 
@@ -196,6 +204,7 @@ def add_admin_routes(router, cluster, runner: ModuleRunner | None = None):
     router.get("/admin/tasks", tasks)
     router.get("/admin/switches", switches)
     router.post("/admin/switch", set_switch)
+    router.post("/admin/forgive", forgive)
     router.get("/admin/modules", modules)
     router.post("/admin/reload", reload)
     return router

@@ -83,6 +83,11 @@ class BlobCli:
         return self._table([{"switch": k, "enabled": v} for k, v in sw.items()],
                            ["switch", "enabled"])
 
+    def cmd_forgive(self, *a) -> str:
+        """Lift access punish windows after a confirmed host/AZ recovery."""
+        self._post("/admin/forgive")
+        return "punish windows cleared"
+
     def cmd_module(self, *a) -> str:
         return self._table(self._get("/admin/modules"), ["name", "running"])
 
@@ -91,8 +96,8 @@ class BlobCli:
 
     def cmd_help(self, *a) -> str:
         return ("commands: stat | disk ls | vol ls | vol info VID | task ls | "
-                "switch ls | switch set NAME on|off | module ls | reload | "
-                "help | exit")
+                "switch ls | switch set NAME on|off | forgive | module ls | "
+                "reload | help | exit")
 
     def dispatch(self, argv: list[str]) -> str:
         if not argv:

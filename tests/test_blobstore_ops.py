@@ -141,3 +141,17 @@ def test_cli_interactive_repl(daemon):
     assert '"disks"' in text
     assert "vol_inspect" in text
     assert "unknown command" in text
+
+
+def test_forgive_clears_punish_windows(daemon):
+    """POST /admin/forgive (CLI: forgive) lifts access punish windows so
+    writes trust a recovered host immediately instead of waiting out
+    punish_secs (the dark-AZ soak's recovery lever, over the admin surface)."""
+    access = daemon.runner.handles["cluster"].access
+    access.punish_disk(4001, "test")
+    assert access._is_punished(4001)
+
+    out = io.StringIO()
+    assert bs_cli(["--addr", daemon.addr, "forgive"], stdout=out) == 0
+    assert "cleared" in out.getvalue()
+    assert not access._is_punished(4001)
