@@ -37,6 +37,7 @@ import numpy as np
 from chubaofs_tpu.ops import rs
 
 TARGET_GBPS = 40.0
+HEADLINE_METRIC = "ec12p4_encode_8mib_stripe"
 
 
 def log(*a):
@@ -160,8 +161,33 @@ def bench_lrc_encode(rng, dev, batch) -> float:
     return batch * t.N * k / per / 1e9
 
 
+def _resolve_device(timeout_s: float = 120.0):
+    """jax.devices() with a watchdog: a wedged TPU tunnel hangs backend init
+    FOREVER (observed: the axon plugin blocks even platform listing), which
+    would hang the whole bench run. The probe runs in a SUBPROCESS (a hung
+    plugin can hold the GIL, so an in-process watchdog thread may never get
+    scheduled to time out); only after it succeeds is the backend initialized
+    here. Fail fast with a diagnosable JSON line instead of hanging."""
+    import subprocess
+
+    try:
+        subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            capture_output=True, timeout=timeout_s, check=True)
+    except Exception as e:  # timeout or nonzero exit: backend unusable
+        err = (f"TPU backend probe failed: {type(e).__name__}"
+               + (" (tunnel down?)"
+                  if isinstance(e, subprocess.TimeoutExpired) else ""))
+        print(json.dumps({
+            "metric": HEADLINE_METRIC, "value": 0.0,
+            "unit": "GB/s", "vs_baseline": 0.0, "error": err,
+        }))
+        sys.exit(2)
+    return jax.devices()[0]
+
+
 def main() -> None:
-    dev = jax.devices()[0]
+    dev = _resolve_device()
     log(f"device={dev}")
     rng = np.random.default_rng(0)
     MiB = 1 << 20
@@ -204,7 +230,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": "ec12p4_encode_8mib_stripe",
+                "metric": HEADLINE_METRIC,
                 "value": cfg["ec12p4_encode_8mib_gbps"],
                 "unit": "GB/s",
                 "vs_baseline": round(headline / TARGET_GBPS, 4),
