@@ -178,6 +178,9 @@ def _resolve_device(timeout_s: float = 120.0):
         err = (f"TPU backend probe failed: {type(e).__name__}"
                + (" (tunnel down?)"
                   if isinstance(e, subprocess.TimeoutExpired) else ""))
+        stderr = getattr(e, "stderr", b"") or b""
+        if stderr:  # the child's traceback tells dead-tunnel from broken-install
+            log(stderr.decode("utf-8", "replace")[-2000:])
         print(json.dumps({
             "metric": HEADLINE_METRIC, "value": 0.0,
             "unit": "GB/s", "vs_baseline": 0.0, "error": err,
