@@ -52,7 +52,19 @@ class ProcCluster:
         self.root = shell.root
         self.env = shell.env
         self.procs: dict[str, subprocess.Popen] = shell.procs
+        try:
+            self._boot(masters, metanodes, datanodes, blobstore, objectnode,
+                       master_extra)
+        except BaseException:
+            # partial boot must not orphan daemons: the constructor is also an
+            # OPERATOR entry (tools/localcluster), and a leader-election or
+            # port-bind failure here would otherwise leak every spawned proc
+            self.close()
+            raise
 
+    def _boot(self, masters, metanodes, datanodes, blobstore, objectnode,
+              master_extra):
+        root = self.root
         # masters need static raft + api ports so peers can dial each other
         raft_ports = {i: free_port() for i in range(1, masters + 1)}
         api_ports = {i: free_port() for i in range(1, masters + 1)}

@@ -208,3 +208,27 @@ def test_console_over_daemon_master(tmp_path):
         if console is not None:
             console.stop()
         master.stop()
+
+
+# -- localcluster (run_docker.sh -r analog) ------------------------------------
+
+
+def test_localcluster_tool_launches_and_serves(tmp_path):
+    """The one-command local cluster comes up, registers its nodes, serves a
+    volume end to end, and tears down cleanly (docker-compose analog)."""
+    import argparse
+
+    from chubaofs_tpu.tools.localcluster import launch
+
+    args = argparse.Namespace(root=str(tmp_path / "lc"), masters=1,
+                              metanodes=3, datanodes=3, blobstore=False,
+                              objectnode=False, jax_platform="cpu")
+    cluster = launch(args)  # constructor already waits for node registration
+    try:
+        mc = cluster.client_master()
+        mc.create_volume("lcvol", cold=False)
+        fs = cluster.fs("lcvol")
+        fs.write_file("/hello.txt", b"from the local cluster tool")
+        assert fs.read_file("/hello.txt") == b"from the local cluster tool"
+    finally:
+        cluster.close()
