@@ -6,7 +6,7 @@ in-process, failures injected by deleting shard files / breaking disks."""
 import numpy as np
 import pytest
 
-from chubaofs_tpu.blobstore.access import Location, LocationError, QuorumError, select_code_mode
+from chubaofs_tpu.blobstore.access import Location, LocationError, select_code_mode
 from chubaofs_tpu.blobstore.cluster import MiniCluster
 from chubaofs_tpu.blobstore.clustermgr import DISK_BROKEN, parse_vuid, make_vuid
 from chubaofs_tpu.codec.codemode import CodeMode

@@ -15,7 +15,7 @@ import pytest
 from chubaofs_tpu.raft import codec, snapcodec
 from chubaofs_tpu.raft.core import Entry, Msg
 from chubaofs_tpu.raft.transport import (
-    DEFAULT_SECRET, TcpNet, _pack, _unwire_msgs, _wire_msgs)
+    DEFAULT_SECRET, TcpNet, _unwire_msgs, _wire_msgs)
 
 
 # -- value codec ---------------------------------------------------------------

@@ -2,7 +2,6 @@
 -run_test analog): POSIX semantics battery (the LTP `fs` group's shape),
 multi-master failover, node-kill recovery, and the S3 flow."""
 
-import json
 import time
 
 import pytest

@@ -6,7 +6,7 @@ import numpy as np
 import pytest
 
 from chubaofs_tpu.codec import CodeMode, EncoderConfig, new_encoder
-from chubaofs_tpu.codec.encoder import InvalidShardsError, VerifyError
+from chubaofs_tpu.codec.encoder import InvalidShardsError
 
 
 def roundtrip(mode, data_len, rng, kill):
