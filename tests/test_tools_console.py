@@ -232,3 +232,26 @@ def test_localcluster_tool_launches_and_serves(tmp_path):
         assert fs.read_file("/hello.txt") == b"from the local cluster tool"
     finally:
         cluster.close()
+
+
+def test_proccluster_boot_failure_reaps_spawned_daemons(tmp_path, monkeypatch):
+    """A partial boot (e.g. leader-election timeout) must not orphan already-
+    spawned daemons: the constructor guard closes them before re-raising."""
+    import subprocess
+    import sys
+
+    from chubaofs_tpu.testing import harness
+
+    spawned = {}
+
+    def fake_boot(self, *a, **kw):
+        p = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(60)"])
+        self.procs["master1"] = p
+        spawned["p"] = p
+        raise TimeoutError("no raft leader within 30s")
+
+    monkeypatch.setattr(harness.ProcCluster, "_boot", fake_boot)
+    with pytest.raises(TimeoutError):
+        harness.ProcCluster(str(tmp_path / "boom"), masters=1, metanodes=0,
+                            datanodes=0)
+    assert spawned["p"].poll() is not None, "orphaned daemon after boot failure"
