@@ -251,7 +251,13 @@ def test_proccluster_boot_failure_reaps_spawned_daemons(tmp_path, monkeypatch):
         raise TimeoutError("no raft leader within 30s")
 
     monkeypatch.setattr(harness.ProcCluster, "_boot", fake_boot)
-    with pytest.raises(TimeoutError):
-        harness.ProcCluster(str(tmp_path / "boom"), masters=1, metanodes=0,
-                            datanodes=0)
-    assert spawned["p"].poll() is not None, "orphaned daemon after boot failure"
+    try:
+        with pytest.raises(TimeoutError):
+            harness.ProcCluster(str(tmp_path / "boom"), masters=1, metanodes=0,
+                                datanodes=0)
+        assert spawned["p"].poll() is not None, (
+            "orphaned daemon after boot failure")
+    finally:
+        if spawned["p"].poll() is None:  # a regression must not leak the child
+            spawned["p"].kill()
+            spawned["p"].wait(timeout=10)
