@@ -85,8 +85,11 @@ class CLI:
         if self.as_json:
             return self._emit(st)
         gib = 1 << 30
-        print(f"Space      : {st['used_space'] / gib:.1f} / "
-              f"{st['total_space'] / gib:.1f} GiB used", file=self.out)
+        d, m = st["data"], st["meta"]
+        print(f"Data space : {d['used_space'] / gib:.1f} / "
+              f"{d['total_space'] / gib:.1f} GiB used", file=self.out)
+        print(f"Meta space : {m['used_space'] / gib:.1f} / "
+              f"{m['total_space'] / gib:.1f} GiB used", file=self.out)
         print(f"Nodes      : {st['active']}/{st['nodes']} active", file=self.out)
         print(f"Volumes    : {st['volumes']} "
               f"(mp={st['meta_partitions']} dp={st['data_partitions']})",

@@ -219,6 +219,10 @@ class GraphQLAPI:
         st = self.master.cluster_stat()
         return {
             "totalSpace": st["total_space"], "usedSpace": st["used_space"],
+            "dataTotalSpace": st["data"]["total_space"],
+            "dataUsedSpace": st["data"]["used_space"],
+            "metaTotalSpace": st["meta"]["total_space"],
+            "metaUsedSpace": st["meta"]["used_space"],
             "nodes": st["nodes"], "active": st["active"],
             "volumes": st["volumes"],
             "metaPartitions": st["meta_partitions"],

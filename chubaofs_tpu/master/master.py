@@ -467,13 +467,21 @@ class Master:
         maintains this in a ticker; here the rollup derives on read — the
         node table is small and raft-replicated, so a loop would only add
         staleness."""
+        def bucket():
+            return {"total_space": 0, "used_space": 0, "nodes": 0, "active": 0}
+
+        def kbucket():  # a rollup with nested per-kind sub-rollups
+            return {**bucket(), "data": bucket(), "meta": bucket()}
+
         zones: dict[str, dict] = {}
-        total = {"total_space": 0, "used_space": 0, "nodes": 0, "active": 0,
-                 "meta_partitions": 0, "data_partitions": 0}
+        # per-kind rollups, like the reference's separate DataNodeStatInfo /
+        # MetaNodeStatInfo (proto/model.go:162): metanode WAL-dir capacity
+        # must not inflate storage capacity. The top-level total/used fields
+        # remain the MERGED sum (all node kinds) for dashboard backward-compat.
+        total = {**kbucket(), "meta_partitions": 0, "data_partitions": 0}
         for n in self.sm.nodes.values():
-            z = zones.setdefault(n.zone, {"total_space": 0, "used_space": 0,
-                                          "nodes": 0, "active": 0})
-            for agg in (z, total):
+            z = zones.setdefault(n.zone, kbucket())
+            for agg in (z, total, total[n.kind], z[n.kind]):
                 agg["total_space"] += n.total_space
                 agg["used_space"] += n.used_space
                 agg["nodes"] += 1

@@ -810,13 +810,24 @@ class ObjectNode:
             if x is None:
                 x = root
             name, value = _text(x, "Key"), _text(x, "Value")
+            # symmetric with get_object_xattr: a <Value encoding="base64">
+            # carries raw bytes, so a GET -> PUT round-trip of a binary
+            # xattr restores the original bytes, not the base64 text
+            velem = x.find("Value")
+            if velem is not None and velem.get("encoding") == "base64":
+                import base64
+                # tolerate pretty-printed / line-wrapped payloads; still
+                # reject non-alphabet garbage
+                raw = base64.b64decode("".join(value.split()), validate=True)
+            else:
+                raw = value.encode()
         except S3Error:
             raise
         except Exception:
             raise S3Error(400, "BadRequest", "malformed PutXAttrRequest") from None
         if not name:
             return Response(200)  # ref: empty key is a silent no-op
-        self._vol(bucket).set_xattr(key, name, value.encode())
+        self._vol(bucket).set_xattr(key, name, raw)
         return Response(200)
 
     def get_object_xattr(self, req: Request):
@@ -836,9 +847,23 @@ class ObjectNode:
                 value = b""  # ref: missing attribute reads as empty value
             else:
                 raise
+        # a binary value set through the FUSE/sdk path cannot travel as XML
+        # text: base64-encode it and flag the encoding, instead of a lossy
+        # utf-8 'replace' that silently corrupts the bytes. Control bytes
+        # other than tab/lf/cr are valid UTF-8 but ILLEGAL in XML 1.0, so
+        # they must take the base64 path too or the response is unparseable.
+        try:
+            text, enc = value.decode("utf-8"), ""
+            if any((ord(c) < 0x20 and c not in "\t\n\r")
+                   or ord(c) in (0xFFFE, 0xFFFF) for c in text):
+                raise UnicodeDecodeError("utf-8", value, 0, 1, "xml-invalid")
+        except UnicodeDecodeError:
+            import base64
+            text, enc = base64.b64encode(value).decode("ascii"), \
+                ' encoding="base64"'
         return Response.xml(
             f"<GetXAttrOutput><XAttr><Key>{esc(name)}</Key>"
-            f"<Value>{esc(value.decode('utf-8', 'replace'))}</Value>"
+            f"<Value{enc}>{esc(text)}</Value>"
             f"</XAttr></GetXAttrOutput>")
 
     def delete_object_xattr(self, req: Request):

@@ -31,7 +31,8 @@ class ProcCluster:
     """A full cluster of daemon subprocesses."""
 
     @classmethod
-    def shell(cls, root: str, env: dict | None = None) -> "ProcCluster":
+    def shell(cls, root: str, env: dict | None = None,
+              jax_platform: str | None = None) -> "ProcCluster":
         """An empty harness (spawn/await/close machinery, no daemons) for
         tests that compose their own role mix."""
         self = cls.__new__(cls)
@@ -41,16 +42,19 @@ class ProcCluster:
         self.env["PYTHONPATH"] = REPO + os.pathsep + self.env.get("PYTHONPATH", "")
         self.env.setdefault("JAX_PLATFORMS", "cpu")
         self.env.update(env or {})
+        self.jax_platform = jax_platform
         self.procs = {}
         return self
 
     def __init__(self, root: str, masters: int = 3, metanodes: int = 3,
                  datanodes: int = 3, blobstore: bool = False,
                  objectnode: bool = False, env: dict | None = None,
-                 master_extra: dict | None = None):
-        shell = ProcCluster.shell(root, env)
+                 master_extra: dict | None = None,
+                 jax_platform: str | None = None):
+        shell = ProcCluster.shell(root, env, jax_platform)
         self.root = shell.root
         self.env = shell.env
+        self.jax_platform = shell.jax_platform
         self.procs: dict[str, subprocess.Popen] = shell.procs
         try:
             self._boot(masters, metanodes, datanodes, blobstore, objectnode,
@@ -135,7 +139,7 @@ class ProcCluster:
         # registered accelerator plugin rewrites JAX_PLATFORMS before main()
         # runs, so env-only requests are silently lost (test daemons must run
         # on CPU, never on a proxied accelerator's health)
-        cfg.setdefault("jaxPlatform", "cpu")
+        cfg.setdefault("jaxPlatform", self.jax_platform or "cpu")
         path = os.path.join(self.root, f"{name}.json")
         with open(path, "w") as f:
             json.dump(cfg, f)

@@ -31,7 +31,9 @@ def launch(args) -> "ProcCluster":
         datanodes=args.datanodes,
         blobstore=args.blobstore or args.objectnode,
         objectnode=args.objectnode,
-        env={"JAX_PLATFORMS": args.jax_platform} if args.jax_platform else None,
+        # config, not env: cmd.py prefers cfg['jaxPlatform'] and ProcCluster
+        # defaults it to cpu, so an env-only request would be silently lost
+        jax_platform=args.jax_platform or None,
     )
 
 

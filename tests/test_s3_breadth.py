@@ -546,6 +546,48 @@ def test_object_xattr_put_get_list_delete(s3env):
     assert xml_of(out).find("XAttr/Value").text is None  # empty value
 
 
+def test_object_xattr_binary_value_base64(s3env):
+    """A binary xattr set via the sdk path must not be silently corrupted
+    by the XML response: it travels base64 with an encoding flag."""
+    import base64
+    s3, node = s3env
+    req(s3, "PUT", "/xbin")
+    req(s3, "PUT", "/xbin/obj", body=b"payload")
+    raw = bytes([0xFF, 0x00, 0x9C, 0x41])  # invalid UTF-8
+    node._vol("xbin").set_xattr("obj", "user.blob", raw)
+    status, _, out = req(s3, "GET", "/xbin/obj",
+                         raw_query="xattr&key=user.blob")
+    assert status == 200
+    val = xml_of(out).find("XAttr/Value")
+    assert val.get("encoding") == "base64"
+    assert base64.b64decode(val.text) == raw
+    # a text value still reads as plain text, no flag
+    node._vol("xbin").set_xattr("obj", "user.txt", b"plain")
+    _, _, out = req(s3, "GET", "/xbin/obj", raw_query="xattr&key=user.txt")
+    val = xml_of(out).find("XAttr/Value")
+    assert val.get("encoding") is None and val.text == "plain"
+    # control bytes are valid UTF-8 but illegal in XML 1.0 text: they must
+    # also travel base64 or the response is unparseable
+    node._vol("xbin").set_xattr("obj", "user.ctl", b"\x01\x02")
+    _, _, out = req(s3, "GET", "/xbin/obj", raw_query="xattr&key=user.ctl")
+    val = xml_of(out).find("XAttr/Value")  # xml_of parsing IS the assertion
+    assert val.get("encoding") == "base64"
+    assert base64.b64decode(val.text) == b"\x01\x02"
+    # U+FFFF is valid UTF-8 but an XML noncharacter: base64 path too
+    node._vol("xbin").set_xattr("obj", "user.nc", "￿".encode())
+    _, _, out = req(s3, "GET", "/xbin/obj", raw_query="xattr&key=user.nc")
+    val = xml_of(out).find("XAttr/Value")
+    assert val.get("encoding") == "base64"
+    # GET -> PUT round-trip: echoing the flagged element back restores the
+    # original BYTES, not the base64 text (whitespace-wrapped payload OK)
+    body = (b'<PutXAttrRequest><XAttr><Key>user.blob2</Key>'
+            b'<Value encoding="base64">\n  ' + base64.b64encode(raw) +
+            b"\n</Value></XAttr></PutXAttrRequest>")
+    status, _, _ = req(s3, "PUT", "/xbin/obj", body=body, raw_query="xattr")
+    assert status == 200
+    assert node._vol("xbin").get_xattr("obj", "user.blob2") == raw
+
+
 def test_object_xattr_errors(s3env):
     s3, _ = s3env
     req(s3, "PUT", "/xbkt2")

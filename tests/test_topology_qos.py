@@ -513,6 +513,14 @@ def test_cluster_stat_rollup(master):
     assert st["zones"]["z0"]["total_space"] == 3000
     assert st["zones"]["z1"]["total_space"] == 4000
     assert st["volumes"] == 0 and st["meta_partitions"] == 0
+    # per-kind split (ref getClusterStat keeps DataNodeStatInfo and
+    # MetaNodeStatInfo separate, proto/model.go:162): metanode WAL space
+    # must not inflate the data-storage capacity figure
+    assert st["data"]["total_space"] == 6000 and st["data"]["used_space"] == 500
+    assert st["meta"]["total_space"] == 1000 and st["meta"]["used_space"] == 250
+    assert st["zones"]["z0"]["data"]["total_space"] == 2000
+    assert st["zones"]["z0"]["meta"]["total_space"] == 1000
+    assert st["zones"]["z1"]["meta"]["total_space"] == 0
 
     # a repeat heartbeat without a space report leaves the numbers alone
     master.heartbeat(100)
