@@ -23,6 +23,7 @@ presigned URLs (SigV4 query auth and SigV2 Expires/Signature).
 
 from __future__ import annotations
 
+import base64
 import re
 import urllib.parse
 import xml.etree.ElementTree as ET
@@ -809,13 +810,13 @@ class ObjectNode:
             x = root.find("XAttr")
             if x is None:
                 x = root
-            name, value = _text(x, "Key"), _text(x, "Value")
+            name = _text(x, "Key")
+            velem = x.find("Value")
+            value = (velem.text or "") if velem is not None else ""
             # symmetric with get_object_xattr: a <Value encoding="base64">
             # carries raw bytes, so a GET -> PUT round-trip of a binary
             # xattr restores the original bytes, not the base64 text
-            velem = x.find("Value")
             if velem is not None and velem.get("encoding") == "base64":
-                import base64
                 # tolerate pretty-printed / line-wrapped payloads; still
                 # reject non-alphabet garbage
                 raw = base64.b64decode("".join(value.split()), validate=True)
@@ -850,15 +851,15 @@ class ObjectNode:
         # a binary value set through the FUSE/sdk path cannot travel as XML
         # text: base64-encode it and flag the encoding, instead of a lossy
         # utf-8 'replace' that silently corrupts the bytes. Control bytes
-        # other than tab/lf/cr are valid UTF-8 but ILLEGAL in XML 1.0, so
-        # they must take the base64 path too or the response is unparseable.
+        # other than tab/lf are valid UTF-8 but ILLEGAL in XML 1.0 (and \r
+        # is legal yet normalized to \n by every parser), so those take the
+        # base64 path too or the response is unparseable/corrupted.
         try:
             text, enc = value.decode("utf-8"), ""
-            if any((ord(c) < 0x20 and c not in "\t\n\r")
+            if any((ord(c) < 0x20 and c not in "\t\n")
                    or ord(c) in (0xFFFE, 0xFFFF) for c in text):
                 raise UnicodeDecodeError("utf-8", value, 0, 1, "xml-invalid")
         except UnicodeDecodeError:
-            import base64
             text, enc = base64.b64encode(value).decode("ascii"), \
                 ' encoding="base64"'
         return Response.xml(

@@ -578,6 +578,13 @@ def test_object_xattr_binary_value_base64(s3env):
     _, _, out = req(s3, "GET", "/xbin/obj", raw_query="xattr&key=user.nc")
     val = xml_of(out).find("XAttr/Value")
     assert val.get("encoding") == "base64"
+    # \r is XML-legal but parsers normalize it to \n — must travel base64
+    # or the round-trip silently turns a\rb into a\nb
+    node._vol("xbin").set_xattr("obj", "user.cr", b"a\rb")
+    _, _, out = req(s3, "GET", "/xbin/obj", raw_query="xattr&key=user.cr")
+    val = xml_of(out).find("XAttr/Value")
+    assert val.get("encoding") == "base64"
+    assert base64.b64decode(val.text) == b"a\rb"
     # GET -> PUT round-trip: echoing the flagged element back restores the
     # original BYTES, not the base64 text (whitespace-wrapped payload OK)
     body = (b'<PutXAttrRequest><XAttr><Key>user.blob2</Key>'
