@@ -7,10 +7,13 @@ per-op audit log via util/auditlog, CHANGELOG.md:10). Kept: a file-descriptor
 table with positional + streaming reads/writes, a TTL'd inode-attribute
 cache and (parent, name) lookup cache invalidated on mutation, the orphan
 list — an unlinked-but-open inode stays readable until its last close, which
-evicts it — and one audit line per namespace op. Changed: the kernel FUSE
-wire is out of scope for this environment; the Mount surface is exactly what
-a fuse_lowlevel adapter (or libsdk's cfs_* C ABI, libsdk/libsdk.go:259) calls
-into, so the kernel shim stays a thin add-on.
+evicts it — and one audit line per namespace op. This path-based surface
+serves in-process consumers and libsdk's cfs_* C ABI (libsdk/libsdk.go:259).
+The kernel FUSE wire lives in client/fuse_ll.py and deliberately does NOT
+layer on Mount: the kernel protocol is nodeid(inode)-based with the fd
+table living in the kernel, so fuse_ll drives MetaWrapper/FsClient inode
+verbs directly; the one shared contract — orphan-inode eviction on last
+close — is intentionally implemented in both (see fuse_ll's module doc).
 """
 
 from __future__ import annotations

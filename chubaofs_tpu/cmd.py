@@ -753,6 +753,28 @@ class ConsoleDaemon(_Daemon):
         self.console.stop()
 
 
+class ClientDaemon(_Daemon):
+    """Role client (client/fuse.go analog): kernel-mount a volume.
+
+    Config: mountPoint, volName, masterAddrs, optional accessAddrs (cold
+    volumes). Requires /dev/fuse; fails fast with a clear error otherwise."""
+
+    def __init__(self, cfg: dict):
+        super().__init__()
+        from chubaofs_tpu.client.fuse_ll import fuse_available, mount_volume
+
+        if not fuse_available():
+            raise SystemExit("role client needs /dev/fuse (and privilege)")
+        self.fuse = mount_volume(cfg["masterAddrs"], cfg["volName"],
+                                 cfg["mountPoint"],
+                                 access_addrs=cfg.get("accessAddrs"))
+        self.addr = cfg["mountPoint"]
+
+    def stop(self):
+        super().stop()
+        self.fuse.unmount()
+
+
 ROLES = {
     "master": MasterDaemon,
     "metanode": MetaNodeDaemon,
@@ -761,6 +783,7 @@ ROLES = {
     "objectnode": ObjectNodeDaemon,
     "authnode": AuthNodeDaemon,
     "console": ConsoleDaemon,
+    "client": ClientDaemon,
 }
 
 
@@ -792,11 +815,13 @@ def main(argv: list[str] | None = None) -> int:
     daemon = start_role(cfg)
     addr = getattr(daemon, "addr", "")
     print(json.dumps({"role": cfg["role"], "addr": addr}), flush=True)
-    try:
-        while True:
-            time.sleep(3600)
-    except KeyboardInterrupt:
-        daemon.stop()
+    # SIGTERM (supervisors, ProcCluster.close) must run the same graceful
+    # stop as ^C: the client role in particular holds a KERNEL MOUNT that
+    # outlives the process unless unmounted here
+    from chubaofs_tpu.utils.shutdown import await_shutdown, shutdown_event
+
+    await_shutdown(shutdown_event())
+    daemon.stop()
     return 0
 
 

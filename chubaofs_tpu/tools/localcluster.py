@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import signal
 import sys
 
 
@@ -55,14 +54,11 @@ def main(argv=None) -> int:
                    help="create this volume once nodes register")
     args = p.parse_args(argv)
 
-    import threading
+    from chubaofs_tpu.utils.shutdown import await_shutdown, shutdown_event
 
     # handlers FIRST: a supervisor that signals the instant it sees the JSON
     # line must hit the graceful path, not the default handler
-    stop = threading.Event()  # Event.wait has no handler/pause race
-    signal.signal(signal.SIGINT, lambda *a: stop.set())
-    signal.signal(signal.SIGTERM, lambda *a: stop.set())
-
+    stop = shutdown_event()
     cluster = launch(args)  # constructor already waits for node registration
     try:
         if args.volume:
@@ -73,7 +69,7 @@ def main(argv=None) -> int:
             "s3_addr": cluster.s3_addr,
             "root": cluster.root,
         }), flush=True)
-        stop.wait()
+        await_shutdown(stop)
         return 0
     finally:
         cluster.close()
