@@ -341,9 +341,14 @@ class DataNode:
         # client reads are leader-only when the partition rides raft: a
         # follower may not have applied the latest random overwrite yet
         # (the reference ships followerRead=false by default for the same
-        # reason). Repair reads target specific replicas and skip the gate.
+        # reason). A packet flagged follower_read opts INTO that relaxed
+        # consistency (volume option, proto/mount_options.go FollowerRead) —
+        # the follower serves from its local store without a leadership
+        # check, which keeps reads alive through elections. Repair reads
+        # target specific replicas and skip the gate the same way.
         if (pkt.opcode == OP_STREAM_READ and dp.raft is not None
-                and not dp.is_raft_leader):
+                and not dp.is_raft_leader
+                and not pkt.arg.get("follower_read")):
             return pkt.reply(RES_NOT_LEADER,
                              arg={"leader": dp.raft.leader_of(dp.pid)})
         size = pkt.arg.get("size", 0)

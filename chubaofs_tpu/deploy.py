@@ -288,8 +288,10 @@ class FsCluster:
 
     # -- volumes ---------------------------------------------------------------------
 
-    def create_volume(self, name: str, cold: bool = True) -> None:
-        self.master().create_volume(name, cold=cold)
+    def create_volume(self, name: str, cold: bool = True,
+                      follower_read: bool = False) -> None:
+        self.master().create_volume(name, cold=cold,
+                                    follower_read=follower_read)
 
     def volume_names(self) -> list[str]:
         return sorted(self.master().sm.volumes)
@@ -302,7 +304,8 @@ class FsCluster:
         vol = self.master().get_volume(volume)
         if vol.cold:
             return FsClient(meta, self.data_backend, cold=True)
-        ec = ExtentClient(lambda: self.master().data_partition_views(volume))
+        ec = ExtentClient(lambda: self.master().data_partition_views(volume),
+                          follower_read=vol.follower_read)
         return FsClient(meta, self.data_backend, hot_backend=HotBackend(ec, meta),
                         cold=False)
 

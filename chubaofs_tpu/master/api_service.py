@@ -161,6 +161,7 @@ class MasterAPI:
             capacity=int(req.q("capacity", str(1 << 40))),
             cold=req.q("volType") == "cold" or req.q("cold") == "true",
             data_partitions=int(req.q("dpCount", "3")),
+            follower_read=req.q("followerRead") == "true",
         )
         if owner and owner in self.master.sm.users:
             self.master.set_vol_owner(owner, name, add=True)
@@ -374,11 +375,13 @@ class MasterClient:
         return self.call("/admin/getTopology")
 
     def create_volume(self, name: str, owner: str = "", cold: bool = False,
-                      capacity: int = 1 << 40, dp_count: int = 3):
+                      capacity: int = 1 << 40, dp_count: int = 3,
+                      follower_read: bool = False):
         return self.call(self._path(
             "/admin/createVol", name=name, owner=owner,
             cold="true" if cold else "false", capacity=capacity,
-            dpCount=dp_count))
+            dpCount=dp_count,
+            followerRead="true" if follower_read else "false"))
 
     def delete_volume(self, name: str):
         return self.call(self._path("/admin/deleteVol", name=name))

@@ -79,6 +79,10 @@ class VolumeView:
     owner: str = ""
     capacity: int = 0
     cold: bool = False  # cold volumes store data in the blobstore (EC tier)
+    # reads may hit any replica (relaxed consistency — a follower can trail
+    # the leader's latest random overwrite); ref proto/mount_options.go
+    # FollowerRead + sdk/data/stream follower-read
+    follower_read: bool = False
     meta_partitions: list[MetaPartitionView] = field(default_factory=list)
     data_partitions: list[DataPartitionView] = field(default_factory=list)
 
@@ -237,10 +241,12 @@ class MasterSM(StateMachine):
         return None
 
     def _op_create_volume(self, name: str, owner: str, capacity: int, cold: bool,
-                          vol_id: int, partition_id: int, peers: list[int]):
+                          vol_id: int, partition_id: int, peers: list[int],
+                          follower_read: bool = False):
         if name in self.volumes:
             raise MasterError(f"volume {name!r} exists")
-        vol = VolumeView(name=name, vol_id=vol_id, owner=owner, capacity=capacity, cold=cold)
+        vol = VolumeView(name=name, vol_id=vol_id, owner=owner, capacity=capacity,
+                         cold=cold, follower_read=follower_read)
         vol.meta_partitions.append(
             MetaPartitionView(partition_id, start=1, end=INF, peers=peers)
         )
@@ -543,13 +549,15 @@ class Master:
         return self._spread_by_zone(datas, count, "data", prefer_zone)
 
     def create_volume(self, name: str, owner: str = "", capacity: int = 1 << 40,
-                      cold: bool = False, data_partitions: int = 3) -> VolumeView:
+                      cold: bool = False, data_partitions: int = 3,
+                      follower_read: bool = False) -> VolumeView:
         vol_id = self._apply("alloc_id")
         pid = self._apply("alloc_id")
         peers = self._pick_meta_peers()
         vol = self._apply(
             "create_volume", name=name, owner=owner, capacity=capacity, cold=cold,
             vol_id=vol_id, partition_id=pid, peers=peers,
+            follower_read=follower_read,
         )
         if self.metanode_hook:
             self.metanode_hook(pid, 1, INF, peers)

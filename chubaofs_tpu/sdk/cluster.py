@@ -37,7 +37,8 @@ class _MasterAdapter:
     def get_volume(self, name: str) -> VolumeView:
         d = self.mc.get_volume(name)
         vol = VolumeView(name=d["name"], vol_id=d["vol_id"], owner=d["owner"],
-                         capacity=d["capacity"], cold=d["cold"])
+                         capacity=d["capacity"], cold=d["cold"],
+                         follower_read=d.get("follower_read", False))
         for mp in d["meta_partitions"]:
             end = INF if mp["end"] < 0 else mp["end"]
             vol.meta_partitions.append(MetaPartitionView(
@@ -119,6 +120,7 @@ class RemoteCluster:
         backend = self.data_backend if self.access_addrs else None
         if vol.cold:
             return FsClient(meta, backend, cold=True)
-        ec = ExtentClient(lambda: self.mc.data_partitions(volume))
+        ec = ExtentClient(lambda: self.mc.data_partitions(volume),
+                          follower_read=vol.follower_read)
         return FsClient(meta, backend, hot_backend=HotBackend(ec, meta),
                         cold=False)

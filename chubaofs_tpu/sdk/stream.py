@@ -46,13 +46,19 @@ class StreamError(Exception):
 class ExtentClient:
     """Partition view + selector + conn pool shared by all streamers."""
 
-    def __init__(self, refresh_partitions, pool: ConnPool | None = None):
+    def __init__(self, refresh_partitions, pool: ConnPool | None = None,
+                 follower_read: bool = False):
         """refresh_partitions() -> [{"pid": int, "hosts": [addr,...]}] — the
-        master's data-partition view for the volume (wrapper.go analog)."""
+        master's data-partition view for the volume (wrapper.go analog).
+        follower_read: volume option — reads may hit ANY replica, ranked by
+        per-host EWMA latency (the reference's FollowerRead + the selector's
+        read-side job, sdk/data/stream follower-read)."""
         self._refresh = refresh_partitions
         self.pool = pool or ConnPool()
+        self.follower_read = follower_read
         self._parts: list[dict] = []
         self._lat: dict[int, float] = {}  # pid -> EWMA seconds
+        self._host_lat: dict[str, float] = {}  # host -> EWMA seconds (reads)
 
     def partitions(self) -> list[dict]:
         if not self._parts:
@@ -73,6 +79,24 @@ class ExtentClient:
     def record_latency(self, pid: int, dt: float) -> None:
         prev = self._lat.get(pid, dt)
         self._lat[pid] = 0.8 * prev + 0.2 * dt
+
+    def record_host_latency(self, host: str, dt: float) -> None:
+        prev = self._host_lat.get(host, dt)
+        self._host_lat[host] = 0.8 * prev + 0.2 * dt
+
+    def read_hosts(self, dp: dict) -> list[str]:
+        """Replica order for a read. Follower-read ranks ALL hosts by EWMA
+        and picks randomly among the fastest half (KFasterRandom applied to
+        replicas, k_faster_random_selector.go:53-58), so a slow or dead
+        leader never sets the read latency floor; the rest follow as
+        fallbacks. Leader-only mode keeps the wire order (leader first)."""
+        hosts = list(dp["hosts"])
+        if not self.follower_read or len(hosts) <= 1:
+            return hosts
+        ranked = sorted(hosts, key=lambda h: self._host_lat.get(h, 0.0))
+        k = max(1, len(ranked) // 2)
+        first = random.choice(ranked[:k])
+        return [first] + [h for h in ranked if h != first]
 
     def find_dp(self, pid: int) -> dict:
         for p in self.partitions():
@@ -110,23 +134,30 @@ class ExtentClient:
     RETRY_WINDOW = 10.0
     RETRY_SLEEP = 0.1
 
-    def request(self, dp: dict, pkt: Packet, retry_hosts: bool = True) -> Packet:
+    def request(self, dp: dict, pkt: Packet, retry_hosts: bool = True,
+                hosts: list[str] | None = None) -> Packet:
         import time as _time
 
         last = None
-        hosts = dp["hosts"] if retry_hosts else dp["hosts"][:1]
+        if hosts is None:
+            hosts = dp["hosts"] if retry_hosts else dp["hosts"][:1]
         deadline = _time.time() + (self.RETRY_WINDOW if retry_hosts else 0)
         while True:
             for addr in hosts:
                 sock = self.pool.get(addr)
+                t0 = _time.perf_counter()
                 try:
                     send_packet(sock, pkt)
                     reply = recv_packet(sock)
                 except (OSError, ConnectionError) as e:
                     self.pool.put(addr, sock, ok=False)
+                    # a dead replica must sink in the read ranking, not
+                    # stay at its last healthy EWMA
+                    self.record_host_latency(addr, self.RETRY_WINDOW)
                     last = StreamError(f"{addr}: {e}")
                     continue
                 self.pool.put(addr, sock)
+                self.record_host_latency(addr, _time.perf_counter() - t0)
                 if reply.result == RES_NOT_LEADER:
                     last = StreamError(f"{addr}: not leader")
                     continue
@@ -135,6 +166,15 @@ class ExtentClient:
                 break
             _time.sleep(self.RETRY_SLEEP)
         raise last or StreamError("no hosts")
+
+    def request_read(self, dp: dict, pkt: Packet) -> Packet:
+        """Read with the volume's consistency mode: follower-read fans the
+        attempt order across EWMA-ranked replicas (and flags the packet so
+        followers serve it); leader-only keeps the plain request path."""
+        if not self.follower_read:
+            return self.request(dp, pkt)
+        pkt.arg["follower_read"] = True
+        return self.request(dp, pkt, hosts=self.read_hosts(dp))
 
 
 class ExtentHandler:
@@ -362,7 +402,7 @@ class Streamer:
                 extent_offset=key.extent_offset + (lo - key.file_offset),
                 arg={"size": hi - lo},
             )
-            rep = self.client.request(pkt=pkt, dp=dp)
+            rep = self.client.request_read(dp, pkt)
             if rep.result != RES_OK:
                 raise StreamError(f"read: {rep.error()}")
             out[lo - offset: hi - offset] = rep.data
