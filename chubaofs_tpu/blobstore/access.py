@@ -153,6 +153,7 @@ class Access:
         policies: list[CodeModePolicy] | None = None,
         per_disk_cap: int = 4,
         write_deadline: float = 10.0,
+        read_deadline: float = 3.0,
         punish_secs: float = 30.0,
         qos=None,
     ):
@@ -172,6 +173,9 @@ class Access:
         # unrelated PUTs
         self.per_disk_cap = per_disk_cap
         self.write_deadline = write_deadline
+        # direct-read patience before a shard is handed to the degraded
+        # path: a wedged blobnode turns into a reconstruct, not a stall
+        self.read_deadline = read_deadline
         self.punish_secs = punish_secs
         self.qos = qos  # optional utils.ratelimit.KeyedLimiter ("put"/"get" bytes)
         self.qos_timeout = 30.0  # max throttle wait before failing the request
@@ -424,20 +428,37 @@ class Access:
             hi = min(offset + size, (idx + 1) * shard_len) - idx * shard_len
             return self._read_shard(vol, idx, blob.bid, lo, hi - lo)
 
-        idxs = range(first_shard, last_shard + 1)
-        if len(idxs) == 1:
-            pieces = [read_one(first_shard)]
-        else:
-            pieces = list(self._read_pool.map(read_one, idxs))
+        # every direct read races a deadline: a shard that cannot answer in
+        # read_deadline (wedged node/disk) is treated as missing and the
+        # degraded path reconstructs around it — the stall is bounded even
+        # when the node never errors (stream_get races laggards the same way)
+        idxs = list(range(first_shard, last_shard + 1))
+        futs = [self._read_pool.submit(read_one, i) for i in idxs]
+        deadline = time.monotonic() + self.read_deadline
+        pieces = []
+        slow: set[int] = set()  # timed out, node possibly wedged
+        for i, f in zip(idxs, futs):
+            try:
+                pieces.append(f.result(timeout=max(0.0, deadline - time.monotonic())))
+            except FutureTimeout:
+                pieces.append(None)
+                slow.add(i)
         if all(p is not None for p in pieces):
             return b"".join(pieces)
-        return self._read_blob_degraded(t, vol, blob, shard_len, offset, size)
+        for f in futs:  # queued laggards must not hold pool workers
+            f.cancel()
+        return self._read_blob_degraded(t, vol, blob, shard_len, offset, size,
+                                        deprioritize=slow)
 
     def _recover_locals_inplace(self, t, vol, blob, stripe, present: list,
-                                shard_len: int) -> None:
+                                shard_len: int,
+                                deadline: float | None = None) -> None:
         """Repair missing GLOBAL shards via their AZ-local stripes, updating
         stripe/present in place. Each AZ is independent: damage within an
-        AZ's local-parity budget is fixed reading ONLY that AZ's shards."""
+        AZ's local-parity budget is fixed reading ONLY that AZ's shards.
+        `deadline` (monotonic) bounds the parity fetches: this runs on the
+        latency-critical degraded path, so a wedged local-parity holder is
+        abandoned like any other straggler, never waited out."""
         pres = set(present)
         for idx_list, local_n, local_m in t.local_stripes():
             globals_in_az = [g for g in idx_list if g < t.N + t.M]
@@ -448,11 +469,17 @@ class Access:
             az_reads: dict[int, np.ndarray] = {
                 g: stripe[g] for g in globals_in_az if g in pres
             }
-            # local parities live outside the global gather; fetch them
-            # concurrently — this runs on the latency-critical degraded path
-            for g, data in zip(locals_in_az, self._read_pool.map(
-                    lambda g: self._read_shard(vol, g, blob.bid, 0, shard_len),
-                    locals_in_az)):
+            futs = {g: self._read_pool.submit(
+                self._read_shard, vol, g, blob.bid, 0, shard_len)
+                for g in locals_in_az}
+            for g, fut in futs.items():
+                budget = (max(0.0, deadline - time.monotonic())
+                          if deadline is not None else None)
+                try:
+                    data = fut.result(timeout=budget)
+                except FutureTimeout:
+                    fut.cancel()
+                    continue
                 if data is not None:
                     az_reads[g] = np.frombuffer(data, np.uint8)
             az_bad = [g for g in idx_list if g not in az_reads]
@@ -484,30 +511,69 @@ class Access:
         except Exception:
             return None
 
-    def _read_blob_degraded(self, t, vol, blob, shard_len, offset, size) -> bytes:
-        """Full-stripe gather + on-the-fly repair of missing data shards
-        (stream_get.go:427 ReconstructData fallback). When the global stripe
-        alone can't reach N survivors and the mode carries local parities,
-        AZ-local stripes are tried first (work_shard_recover.go:517
-        recoverByLocalStripe applied at READ time) — e.g. one dark AZ plus a
-        corrupt shard elsewhere exceeds M globally but the corrupt shard's own
-        AZ can still repair it locally. Read-only: durable healing stays with
-        the repair plane via the shard-repair topic."""
-        stripe = np.zeros((t.N + t.M, shard_len), np.uint8)
-        present = []
-        reads = list(self._read_pool.map(
-            lambda idx: self._read_shard(vol, idx, blob.bid, 0, shard_len),
-            range(t.N + t.M)))
-        for idx, data in enumerate(reads):
-            if data is not None:
-                stripe[idx] = np.frombuffer(data, np.uint8)
-                present.append(idx)
-        # the repair plane must hear about EVERYTHING the gather proved
+    def _read_blob_degraded(self, t, vol, blob, shard_len, offset, size,
+                            deprioritize: set[int] | None = None) -> bytes:
+        """Hedged stripe gather + on-the-fly repair of missing data shards
+        (stream_get.go:427 ReconstructData fallback). The gather keeps
+        `t.read_hedge` (get_quorum-bounded) speculative reads in flight and
+        finishes the moment N shards arrive — stragglers are abandoned, and
+        each FAILED read immediately launches a replacement from the not-yet-
+        tried shards, so one slow or dead blobnode never sets the GET latency
+        floor. `deprioritize` (shards the direct phase saw time out) go LAST
+        so the gather never re-blocks a worker on a known-wedged node first.
+        When the global stripe alone can't reach N survivors and the mode
+        carries local parities, AZ-local stripes are tried next
+        (work_shard_recover.go:517 recoverByLocalStripe applied at READ
+        time). Read-only: durable healing stays with the repair plane via
+        the shard-repair topic."""
+        from concurrent.futures import FIRST_COMPLETED, wait
+
+        total = t.N + t.M
+        stripe = np.zeros((total, shard_len), np.uint8)
+        present: list[int] = []
+        failed: list[int] = []
+        slow = deprioritize or set()
+        # data shards first (they skip the matmul); known-wedged ones last
+        order = sorted(range(total), key=lambda i: (i in slow, i))
+        pending = {
+            self._read_pool.submit(
+                self._read_shard, vol, idx, blob.bid, 0, shard_len): idx
+            for idx in order[:t.read_hedge]
+        }
+        next_i = t.read_hedge
+        # overall gather budget: stragglers can be slow-but-alive, so this
+        # is the generous write_deadline, not the per-read read_deadline
+        gather_deadline = time.monotonic() + self.write_deadline
+        while pending and len(present) < t.N:
+            done, _ = wait(pending, return_when=FIRST_COMPLETED,
+                           timeout=max(0.0, gather_deadline - time.monotonic()))
+            if not done:  # budget exhausted: abandon what never answered
+                break
+            for fut in done:
+                idx = pending.pop(fut)
+                data = fut.result()
+                if data is not None:
+                    stripe[idx] = np.frombuffer(data, np.uint8)
+                    present.append(idx)
+                elif next_i < total:  # replace the failure, keep hedge depth
+                    failed.append(idx)
+                    nxt = order[next_i]
+                    next_i += 1
+                    pending[self._read_pool.submit(
+                        self._read_shard, vol, nxt, blob.bid, 0, shard_len)] = nxt
+                else:
+                    failed.append(idx)
+        for fut in pending:  # abandon stragglers (queued ones cancel cleanly)
+            fut.cancel()
+        # the repair plane must hear about everything the gather PROVED
         # damaged — including shards the local-stripe pass then fixes only
-        # in memory (they are still broken on disk)
-        damaged = [i for i in range(t.N + t.M) if i not in present]
+        # in memory (they are still broken on disk). Shards the hedge never
+        # reached are probed ASYNCHRONOUSLY (off the latency path) below, so
+        # hedging does not narrow get_miss-driven healing vs a full gather.
+        damaged = sorted(failed)
         if len(present) < t.N and getattr(t, "L", 0):
-            self._recover_locals_inplace(t, vol, blob, stripe, present, shard_len)
+            self._recover_locals_inplace(t, vol, blob, stripe, present,
+                                         shard_len, deadline=gather_deadline)
         missing = [i for i in range(t.N + t.M) if i not in present]
         if len(present) < t.N:
             raise AccessError(
@@ -515,8 +581,26 @@ class Access:
             )
         fixed = self.codec.reconstruct(t.N, t.M, stripe, missing, data_only=True).result()
         self.proxy.send_shard_repair(vol.vid, blob.bid, damaged, "get_miss")
+        unprobed = [i for i in range(total)
+                    if i not in present and i not in failed]
+        if unprobed:
+            self._pool.submit(self._probe_shards, t, vol, blob, shard_len,
+                              unprobed)
         data_region = fixed[: t.N].reshape(-1)
         return data_region[offset : offset + size].tobytes()
+
+    def _probe_shards(self, t, vol, blob, shard_len, idxs: list[int]) -> None:
+        """Background integrity probe of shards a hedged gather skipped or
+        abandoned: full CRC-framed reads, failures reported to the repair
+        plane. Keeps get_miss healing as wide as the old full-stripe gather
+        without ever charging the GET's latency."""
+        bad = [i for i in idxs
+               if self._read_shard(vol, i, blob.bid, 0, shard_len) is None]
+        if bad:
+            try:
+                self.proxy.send_shard_repair(vol.vid, blob.bid, bad, "get_probe")
+            except Exception:
+                pass  # scrub/inspector sweeps remain the durable backstop
 
     # -- DELETE --------------------------------------------------------------
 

@@ -61,6 +61,17 @@ class Tactic:
         return self.N + self.M + self.L
 
     @property
+    def read_hedge(self) -> int:
+        """How many shard reads a degraded GET keeps in flight at once:
+        N would-be-sufficient reads plus speculative extras, so one slow or
+        dead blobnode never sets the GET latency floor (the reference hedges
+        the same way — getDataShardOnly fans out, reconstruct fallback races
+        the stragglers, stream_get.go:427-530). `get_quorum` is the explicit
+        per-mode bound; 0 (unset) defaults to N + ceil(M/2), capped at N+M."""
+        hedge = self.get_quorum or self.N + (self.M + 1) // 2
+        return min(hedge, self.N + self.M)
+
+    @property
     def global_count(self) -> int:
         return self.N + self.M
 

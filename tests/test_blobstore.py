@@ -228,6 +228,57 @@ def test_chunk_crc_detects_corruption(tmp_path, rng):
         n1.get_shard(vuid, 7)
 
 
+def test_degraded_get_hedges_past_slow_blobnode(cluster, rng):
+    """One SLOW (not dead) blobnode must not set the degraded-GET latency
+    floor: the gather keeps t.read_hedge speculative reads in flight and
+    returns when N shards arrive, abandoning the straggler (get_quorum
+    wiring; ref stream_get.go:427-530 races reconstruct against laggards)."""
+    import time as _time
+
+    data = blob_bytes(rng, 2_000_000)
+    loc = cluster.access.put(data, code_mode=CodeMode.EC12P4)
+    blob = loc.blobs[0]
+    vol = cluster.cm.get_volume(blob.vid)
+
+    # kill one data shard so the GET takes the degraded path
+    unit = vol.units[3]
+    cluster.nodes[unit.node_id].lose_shard(unit.vuid, blob.bid)
+
+    # wedge ANOTHER data shard's node: reads of it hang 30s. EC12P4 hedges
+    # N + ceil(M/2) = 14 of 16 reads concurrently, so the stripe completes
+    # from the other 14 shards without ever waiting on the wedged one.
+    slow_unit = vol.units[7]
+    slow_node = cluster.nodes[slow_unit.node_id]
+    orig_get = slow_node.get_shard
+
+    def slow_get(vuid, bid, offset=0, size=None):
+        if bid == blob.bid and vuid == slow_unit.vuid:
+            _time.sleep(30)
+        return orig_get(vuid, bid, offset=offset, size=size)
+
+    slow_node.get_shard = slow_get
+    try:
+        t0 = _time.perf_counter()
+        assert cluster.access.get(loc) == data
+        elapsed = _time.perf_counter() - t0
+        assert elapsed < 10, f"GET waited on the wedged blobnode ({elapsed:.1f}s)"
+    finally:
+        slow_node.get_shard = orig_get
+
+
+def test_read_hedge_bounds():
+    from chubaofs_tpu.codec.codemode import get_tactic
+
+    t = get_tactic(CodeMode.EC12P4)
+    assert t.read_hedge == 14  # N + ceil(M/2), within N+M
+    assert get_tactic(CodeMode.EC6P3).read_hedge == 8
+    # an explicit get_quorum bounds the hedge
+    from chubaofs_tpu.codec.codemode import Tactic
+
+    assert Tactic(4, 2, 0, 1, put_quorum=5, get_quorum=5).read_hedge == 5
+    assert Tactic(4, 2, 0, 1, put_quorum=5, get_quorum=99).read_hedge == 6
+
+
 def test_repair_task_dedup(cluster, rng):
     """N degraded GETs of one stripe produce ONE open repair task."""
     data = blob_bytes(rng, 2_000_000)
