@@ -51,8 +51,11 @@ def plane_major(mat_bits: np.ndarray) -> np.ndarray:
     return np.asarray(mat_bits)[_perm(r8 // BITS)][:, _perm(n8 // BITS)]
 
 
-def pick_group(b: int, r8: int, n8: int) -> int:
+def pick_group(b: int, r8: int, n8: int, cap: int | None = None) -> int:
     """Largest divisor g of the batch with g*r8 <= 128 and g*n8 <= 512.
+
+    ``cap`` additionally bounds g (e.g. a dp-sharded caller passes b//dp so
+    grouping never collapses the batch below the mesh's data-parallel axis).
 
     Block-diagonal generator stacking (PERF.md "paths past 100"): the stationary
     matrix of one EC(12,4) stripe is 32x96 on a 128x128 systolic array (~19%
@@ -70,7 +73,8 @@ def pick_group(b: int, r8: int, n8: int) -> int:
     rs.group_stack packages the host-side transform.
     """
     best = 1
-    for g in range(2, min(b, 128) + 1):
+    hi = min(b, 128) if cap is None else min(b, 128, cap)
+    for g in range(2, hi + 1):
         if g * r8 > 128 or g * n8 > 512:
             break
         if b % g == 0:

@@ -1,0 +1,193 @@
+"""Manual-DMA double-buffered variant of the fused GF(2^8) kernel.
+
+PERF.md headroom #1: in the auto-pipelined kernel (ops/pallas_gf.py) each
+grid step runs unpack (VPU) -> bit-matmul (MXU) -> pack (VPU) as one
+dependency chain, so the MXU idles during every unpack/pack and the VPU
+during every matmul; Mosaic's automatic pipelining overlaps only the HBM
+DMAs, not compute across steps. The cheap fixes measured in PERF.md all
+lose because any in-kernel restructuring of the AUTO-pipelined body breaks
+Mosaic's streaming fusion of the unpack chain.
+
+This kernel takes the full rewrite instead (dimension_semantics=arbitrary):
+one grid step per stripe row owns its whole k-range and drives a SKEWED
+software pipeline with manual HBM<->VMEM DMAs —
+
+    iteration t:  wait in-DMA(t)   -> unpack tile t     (VPU)
+                  start in-DMA(t+1)
+                  matmul tile t-1  (MXU)  + pack + start out-DMA(t-1)
+
+unpack(t) writes bits[t%2] while the matmul reads bits[(t-1)%2]: no data
+dependence, so the scheduler may overlap VPU and MXU work that the fused
+kernel serializes. The price is materialized bit-planes (8n x kt int8 per
+slot) — the very thing streaming fusion avoids — which caps the tile size
+by VMEM (16 MB): all buffers are ~176*n bytes per column, so kt is chosen
+to keep the resident set near 10 MB.
+
+Whether the overlap beats the lost fusion is an empirical question the
+bench answers per chip; rs.gf_matmul_dispatch keeps the fused kernel as
+the default and selects this one via CFS_GF_PIPELINED=1.
+
+Reference counterpart: same as pallas_gf.py — the klauspost/reedsolomon
+assembly loops (SURVEY §2.3), which pipeline loads against GF multiplies
+the same way at the x86 cache hierarchy scale.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from chubaofs_tpu.ops.pallas_gf import BITS, _perm, plane_major
+
+# resident VMEM per column of tile: data(2n) + bits(2*8n) + out(2r) + acc
+# (8r int32) bytes; target ~10 MiB so the compiler keeps headroom for
+# spills/alignment within the 16 MiB budget
+VMEM_TARGET = 10 << 20
+
+
+def _pick_tile(n: int, r: int, k: int) -> int:
+    per_col = 2 * n + 2 * 8 * n + 2 * r + 4 * 8 * r
+    kt = VMEM_TARGET // per_col // 128 * 128
+    return max(128, min(kt, k, 65536))
+
+
+def _make_kernel(n: int, r: int, kt: int, n_tiles: int):
+    """Kernel body for one stripe row: manual skewed double-buffer pipeline."""
+
+    def kernel(mat_ref, data_hbm, out_hbm, data_buf, bits_buf, out_buf,
+               in_sems, out_sems):
+        i = pl.program_id(0)
+
+        def in_dma(slot, t):
+            return pltpu.make_async_copy(
+                data_hbm.at[i].at[:, pl.ds(t * kt, kt)],
+                data_buf.at[slot], in_sems.at[slot])
+
+        def out_dma(slot, t):
+            return pltpu.make_async_copy(
+                out_buf.at[slot],
+                out_hbm.at[i].at[:, pl.ds(t * kt, kt)], out_sems.at[slot])
+
+        def unpack(slot):
+            d32 = data_buf[slot].astype(jnp.int32)
+            planes = [((d32 >> bb) & 1).astype(jnp.int8) for bb in range(BITS)]
+            bits_buf[slot] = jnp.concatenate(planes, axis=0)
+
+        def compute(slot):
+            acc = jax.lax.dot_general(
+                mat_ref[...], bits_buf[slot],
+                dimension_numbers=(((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            packed = acc[0:r] & 1
+            for bb in range(1, BITS):
+                packed |= (acc[bb * r:(bb + 1) * r] & 1) << bb
+            out_buf[slot] = packed.astype(jnp.uint8)
+
+        in_dma(0, 0).start()
+
+        def body(t, _):
+            slot = jax.lax.rem(t, 2)
+            prev = jax.lax.rem(t + 1, 2)  # == (t-1) % 2
+
+            @pl.when(t < n_tiles)
+            def _load_unpack():
+                in_dma(slot, t).wait()
+
+                @pl.when(t + 1 < n_tiles)
+                def _():
+                    in_dma(prev, t + 1).start()
+
+                unpack(slot)
+
+            @pl.when(t >= 1)
+            def _compute_store():
+                tc = t - 1
+
+                @pl.when(tc >= 2)
+                def _():  # slot reuse: tile tc-2 used the same out slot
+                    out_dma(prev, tc - 2).wait()
+
+                compute(prev)
+                out_dma(prev, tc).start()
+
+            return 0
+
+        jax.lax.fori_loop(0, n_tiles + 1, body, 0)
+        # drain the last two out-DMAs (slots of tiles T-1 and T-2)
+        out_dma((n_tiles - 1) % 2, n_tiles - 1).wait()
+        if n_tiles >= 2:
+            out_dma((n_tiles - 2) % 2, n_tiles - 2).wait()
+
+    return kernel
+
+
+def gf_matmul_bytes_pipelined(
+    mat_bits: jax.Array,
+    shards: jax.Array,
+    tile_k: int | None = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Drop-in equivalent of pallas_gf.gf_matmul_bytes_fused (same contract:
+    byte-major (8r, 8n) matrix, (..., n, k) uint8 shards -> (..., r, k))."""
+    r8, n8 = mat_bits.shape
+    r, n = r8 // BITS, n8 // BITS
+    lead = shards.shape[:-2]
+    k = shards.shape[-1]
+    assert shards.shape[-2] == n, (shards.shape, mat_bits.shape)
+    if r8 == 0 or k == 0:
+        return jnp.zeros((*lead, r, k), jnp.uint8)
+    b = 1
+    for d in lead:
+        b *= d
+    if isinstance(mat_bits, np.ndarray):
+        mat_pm = plane_major(mat_bits).astype(np.int8)
+    else:
+        mat_pm = mat_bits[jnp.asarray(_perm(r))][:, jnp.asarray(_perm(n))]
+    out = _pipe_core(mat_pm, shards.reshape(b, n, k), tile_k=tile_k,
+                     interpret=interpret)
+    return out.reshape(*lead, r, k)
+
+
+@functools.partial(jax.jit, static_argnames=("tile_k", "interpret"))
+def _pipe_core(mat_pm, data, tile_k, interpret):
+    b, n, k = data.shape
+    r8, n8 = mat_pm.shape
+    r = r8 // BITS
+
+    kt = tile_k or _pick_tile(n, r, k)
+    k128 = -(-k // 128) * 128
+    n_tiles = max(1, -(-k128 // kt))
+    kt = -(-k128 // n_tiles // 128) * 128
+    kp = kt * n_tiles
+    if kp != k:
+        data = jnp.pad(data, ((0, 0), (0, 0), (0, kp - k)))
+
+    out = pl.pallas_call(
+        _make_kernel(n, r, kt, n_tiles),
+        grid=(b,),
+        in_specs=[
+            pl.BlockSpec((r8, n8), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),  # whole array stays in HBM
+        ],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        out_shape=jax.ShapeDtypeStruct((b, r, kp), jnp.uint8),
+        scratch_shapes=[
+            pltpu.VMEM((2, n, kt), jnp.uint8),       # data tiles
+            pltpu.VMEM((2, 8 * n, kt), jnp.int8),    # unpacked bit-planes
+            pltpu.VMEM((2, r, kt), jnp.uint8),       # packed results
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary",)),
+        interpret=interpret,
+    )(mat_pm, data)
+
+    if kp != k:
+        out = out[..., :k]
+    return out

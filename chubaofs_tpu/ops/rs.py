@@ -76,6 +76,14 @@ def _use_fused() -> bool:
 def gf_matmul_dispatch(mat_bits: jax.Array, shards: jax.Array) -> jax.Array:
     """Pick the fastest available lowering for a standalone (non-traced) call."""
     if _use_fused():
+        import os
+
+        if os.environ.get("CFS_GF_PIPELINED") == "1":
+            # manual-DMA double-buffered variant (PERF.md headroom #1);
+            # opt-in until the bench proves it beats streaming fusion
+            from chubaofs_tpu.ops import pallas_gf_pipe
+
+            return pallas_gf_pipe.gf_matmul_bytes_pipelined(mat_bits, shards)
         from chubaofs_tpu.ops import pallas_gf
 
         return pallas_gf.gf_matmul_bytes_fused(mat_bits, shards)
