@@ -27,9 +27,14 @@ class MiniCluster:
         disks_per_node: int = 2,
         azs: int = 1,
         persist_cm: bool = True,
+        codec: CodecService | None = None,
     ):
+        """codec: inject a shared/mesh-backed CodecService (e.g. one built
+        with a jax Mesh so access PUT/GET and scheduler repair run their
+        device math dp/sp-sharded across every chip); default single-device."""
         self.root = root
-        self.codec = CodecService()
+        self._owns_codec = codec is None  # injected services outlive us
+        self.codec = codec or CodecService()
         self.cm = ClusterMgr(os.path.join(root, "cm") if persist_cm else None)
         self.nodes: dict[int, BlobNode] = {}
         for n in range(1, n_nodes + 1):
@@ -73,7 +78,8 @@ class MiniCluster:
         }
 
     def close(self):
-        self.codec.close()
+        if self._owns_codec:  # never kill a shared/injected service
+            self.codec.close()
         for node in self.nodes.values():
             node.close()
         self.cm.close()

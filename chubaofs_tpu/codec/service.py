@@ -57,9 +57,22 @@ class _Job:
 class CodecService:
     """Queue -> padded device batches -> futures. Thread-safe, one device stream."""
 
-    def __init__(self, max_batch: int = 32, max_wait_ms: float = 2.0):
+    def __init__(self, max_batch: int = 32, max_wait_ms: float = 2.0,
+                 mesh=None, mesh_interpret: bool = False):
+        """mesh: optional jax.sharding.Mesh (dp, sp) — drained batches then
+        run through parallel.mesh.sharded_gf_matmul instead of the single-
+        device path, which takes the whole blobstore data plane (access
+        PUT/GET, scheduler bulk repair) multi-chip without any caller
+        change (SURVEY §7 step 6). mesh_interpret forces the Pallas kernel
+        in interpret mode on CPU meshes (the dryrun/test path)."""
         self.max_batch = max_batch
         self.max_wait = max_wait_ms / 1e3
+        self.mesh = mesh
+        self._mesh_mm = None
+        if mesh is not None:
+            from chubaofs_tpu.parallel.mesh import sharded_gf_matmul
+
+            self._mesh_mm = sharded_gf_matmul(mesh, interpret=mesh_interpret)
         self._q: queue.Queue[_Job | None] = queue.Queue()
         self._thread = threading.Thread(target=self._run, daemon=True, name="codec-svc")
         self._started = False
@@ -224,17 +237,17 @@ class CodecService:
             stack[i, :, : j.k] = j.data
         # both paths go through the host-boundary grouped entry: batches of
         # stripes are viewed (free numpy reshape) as MXU-row-filling groups
-        # before they ever reach the device (rs.gf_matmul_hostbatch)
+        # before they ever reach the device (rs.gf_matmul_hostbatch) — or,
+        # with a mesh, fan out dp/sp-sharded across every device
+        mm = self._mesh_mm or rs.gf_matmul_hostbatch
         if sig[0] == "encode":
             kernel = rs.get_kernel(jobs[0].n, jobs[0].m)
-            parity = rs.gf_matmul_hostbatch(kernel.parity_bits, stack)
+            parity = mm(kernel.parity_bits, stack)
             out = np.concatenate([stack, parity], axis=1)  # (B, n+m, kb)
         else:
             from chubaofs_tpu.ops import bitmatrix
 
-            out = rs.gf_matmul_hostbatch(
-                bitmatrix.expand_matrix(jobs[0].mat).astype(np.int8), stack
-            )
+            out = mm(bitmatrix.expand_matrix(jobs[0].mat).astype(np.int8), stack)
         for i, j in enumerate(jobs):
             j.future.set_result(out[i, :, : j.k])
 

@@ -5,6 +5,7 @@ from chubaofs_tpu.parallel.mesh import (
     group_view,
     shard_stripes,
     sharded_codec_step,
+    sharded_gf_matmul,
     ungroup_stripe,
 )
 
@@ -13,5 +14,6 @@ __all__ = [
     "group_view",
     "shard_stripes",
     "sharded_codec_step",
+    "sharded_gf_matmul",
     "ungroup_stripe",
 ]

@@ -87,6 +87,93 @@ def _grouped_row(s: int, gi: int, g: int, n: int, m: int) -> int:
     return gi * n + s if s < n else g * n + gi * m + (s - n)
 
 
+def _select_gf(mesh: Mesh, fused: bool | None, interpret: bool):
+    """(gf, use_fused) for this mesh. Auto-select keys off the MESH's
+    platform, not the default backend: under axon the default is a proxied
+    TPU while the dryrun mesh is CPU devices — compiling the Mosaic kernel
+    for a CPU mesh would crash. interpret=True forces the Pallas kernel in
+    interpret mode (CPU-mesh tests of the real kernel)."""
+    mesh_platform = next(iter(mesh.devices.flat)).platform
+    use_fused = interpret or (
+        fused if fused is not None else mesh_platform == "tpu"
+    )
+
+    def gf(mat_bits, x):
+        if use_fused:
+            from chubaofs_tpu.ops import pallas_gf
+
+            # numpy matrices pass through unconverted so the plane-major
+            # permutation runs in numpy at trace time; traced matrices pay
+            # a tiny in-graph gather instead
+            return pallas_gf.gf_matmul_bytes_fused(mat_bits, x,
+                                                   interpret=interpret)
+        return rs.gf_matmul_bytes(mat_bits, x)
+
+    return gf, use_fused
+
+
+def sharded_gf_matmul(mesh: Mesh, *, fused: bool | None = None,
+                      interpret: bool = False):
+    """Mesh-wide drop-in for ``rs.gf_matmul_hostbatch``: host (B, n, k)
+    batches x a byte-major bit matrix -> host (B, r, k), sharded B over
+    ``dp`` and k over ``sp``, with the MXU group-stacked layout taken at the
+    host boundary (PERF.md). This is how CodecService — and therefore the
+    whole blobstore data plane above it (access PUT/GET, scheduler bulk
+    repair) — runs on more than one chip: the service stays a single queue,
+    but every drained batch fans out across the mesh.
+
+    The matrix rides as a RUNTIME argument (replicated), so every repair
+    pattern of the same shape shares one compiled program — exactly the
+    ``sharded_codec_step`` plan contract, applied to the service's generic
+    matmul jobs."""
+    gf, use_fused = _select_gf(mesh, fused, interpret)
+
+    @functools.partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(), P("dp", None, "sp")),
+        out_specs=P("dp", None, "sp"),
+        check_vma=False,
+    )
+    def mm(mat, data):
+        return gf(mat, data)
+
+    jitted = jax.jit(mm)
+    replicated = NamedSharding(mesh, P())
+    dp, sp = mesh.shape["dp"], mesh.shape["sp"]
+
+    def run(mat_bits: np.ndarray, batch: np.ndarray) -> np.ndarray:
+        batch = np.asarray(batch, np.uint8)
+        mat_bits = np.asarray(mat_bits, np.int8)
+        b, n, k = batch.shape
+        r = mat_bits.shape[0] // 8
+        if b == 0 or r == 0 or k == 0:
+            return np.zeros((b, r, k), np.uint8)
+        if use_fused:
+            from chubaofs_tpu.ops import pallas_gf
+
+            # cap g so grouping never collapses the batch below dp (every
+            # mesh row must keep real stripes, not padding)
+            g = pallas_gf.pick_group(b, *mat_bits.shape, cap=max(1, b // dp))
+        else:
+            g = 1
+        mat_s = np.kron(np.eye(g, dtype=np.int8), mat_bits) if g > 1 else mat_bits
+        data = group_view(batch, g) if g > 1 else batch
+        pad_rows = (-data.shape[0]) % dp
+        if pad_rows:  # zero stripes encode trivially; sliced back out below
+            data = np.concatenate(
+                [data, np.zeros((pad_rows, g * n, k), np.uint8)])
+        kpad = (-k) % (sp * 128)
+        if kpad:
+            data = np.pad(data, ((0, 0), (0, 0), (0, kpad)))
+        with mesh:
+            out = jitted(jax.device_put(mat_s, replicated),
+                         shard_stripes(mesh, data))
+        out = np.asarray(out)[: b // g, :, :k]
+        return out.reshape(b, r, k)
+
+    return run
+
+
 def sharded_codec_step(
     mesh: Mesh, n: int, m: int, *, fused: bool | None = None,
     interpret: bool = False, group: int = 1
@@ -131,25 +218,7 @@ def sharded_codec_step(
         parity_bits = kernel.parity_bits
     else:
         parity_bits = np.kron(np.eye(g, dtype=np.int8), kernel.parity_bits)
-    # auto-select keys off the MESH's platform, not the default backend: under
-    # axon the default is a proxied TPU while the dryrun mesh is CPU devices —
-    # compiling the Mosaic kernel for a CPU mesh would crash the dryrun
-    mesh_platform = next(iter(mesh.devices.flat)).platform
-    use_fused = interpret or (
-        fused if fused is not None else mesh_platform == "tpu"
-    )
-
-    def gf(mat_bits, x):
-        if use_fused:
-            from chubaofs_tpu.ops import pallas_gf
-
-            # numpy matrices (the generator — kron-stacked already when
-            # group > 1) pass through unconverted so the plane-major
-            # permutation runs in numpy at trace time; traced repair matrices
-            # pay a tiny in-graph gather instead
-            return pallas_gf.gf_matmul_bytes_fused(mat_bits, x, interpret=interpret)
-        return rs.gf_matmul_bytes(jnp.asarray(mat_bits), x)
-
+    gf, use_fused = _select_gf(mesh, fused, interpret)
     sp_size = mesh.shape["sp"]
     trace_count = [0]
 
