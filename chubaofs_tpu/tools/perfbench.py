@@ -1,0 +1,179 @@
+"""Hot-path perf harness — the mdtest/fio analog over real daemon clusters.
+
+Reference counterpart: the published evaluation suite
+(/root/reference/docs/source/evaluation/ — mdtest file create/stat/removal
+ops/s, fio streaming MB/s, tiny-file TPS; BASELINE.md carries the numbers
+from a 10-node 32-core cluster on 10 Gb/s networking). This harness measures
+the SAME axes against a ProcCluster of real subprocess daemons, so every op
+crosses the client/metanode/datanode process boundaries the way the
+reference's benchmarks cross machines.
+
+Single-host caveat (PERF.md records the scaling argument next to these
+numbers): everything here shares one host's cores, so absolute figures are
+per-node floors, not cluster aggregates. The reference's cluster numbers
+scale out with node count because metadata partitions and data partitions
+shard across machines — the same sharding this repo implements — so the
+honest comparison is ops/s-per-metanode and MB/s-per-datanode.
+
+Usage:
+    python -m chubaofs_tpu.tools.perfbench [--clients N] [--files N]
+        [--stream-mb N] [--root DIR]
+
+Prints exactly ONE JSON line: {"metric": "mdtest_create_ops", ...,
+"configs": {...}}. Diagnostics go to stderr.
+"""
+
+from __future__ import annotations
+
+import json
+import shutil
+import sys
+import tempfile
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def bench_metadata(cluster, volume: str, n_files: int, n_clients: int) -> dict:
+    """mdtest analog: create / stat / remove ops/s, 1 and N clients.
+
+    Each client works in its own directory (mdtest -u), so creates contend
+    on the shared metanode partitions, not on a single directory lock."""
+    from chubaofs_tpu.sdk.cluster import RemoteCluster
+
+    out = {}
+    for clients in sorted({1, n_clients}):
+        fss = [RemoteCluster(cluster.master_addrs).client(volume)
+               for _ in range(clients)]
+        per = n_files // clients
+        for fs, c in zip(fss, range(clients)):
+            fs.mkdirs(f"/md{clients}/c{c}")
+
+        def phase(verb):
+            def client_run(args):
+                fs, c = args
+                base = f"/md{clients}/c{c}"
+                for i in range(per):
+                    verb(fs, f"{base}/f{i}")
+            with ThreadPoolExecutor(clients) as pool:
+                list(pool.map(client_run, zip(fss, range(clients))))
+
+        dt = _timed(lambda: phase(lambda fs, p: fs.create(p)))
+        out[f"create_ops_{clients}c"] = round(per * clients / dt, 1)
+        dt = _timed(lambda: phase(lambda fs, p: fs.stat(p)))
+        out[f"stat_ops_{clients}c"] = round(per * clients / dt, 1)
+        dt = _timed(lambda: phase(lambda fs, p: fs.unlink(p)))
+        out[f"remove_ops_{clients}c"] = round(per * clients / dt, 1)
+        log(f"  mdtest {clients} client(s): "
+            f"create={out[f'create_ops_{clients}c']} "
+            f"stat={out[f'stat_ops_{clients}c']} "
+            f"remove={out[f'remove_ops_{clients}c']} ops/s")
+    return out
+
+
+def bench_stream(cluster, volume: str, total_mb: int) -> dict:
+    """fio analog: sequential write then read MB/s through the chain-repl
+    path (one streaming client, 1 MiB IOs, 3-replica write amplification)."""
+    from chubaofs_tpu.sdk.cluster import RemoteCluster
+
+    fs = RemoteCluster(cluster.master_addrs).client(volume)
+    chunk = b"\xa5" * (1 << 20)
+    ino = fs.create("/stream.bin")
+    t0 = time.perf_counter()
+    for i in range(total_mb):
+        fs.write_at(ino, i << 20, chunk)
+    wdt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    got = 0
+    for i in range(total_mb):
+        got += len(fs.read_at(ino, i << 20, 1 << 20))
+    rdt = time.perf_counter() - t0
+    assert got == total_mb << 20
+    out = {"seq_write_mbps": round(total_mb / wdt, 1),
+           "seq_read_mbps": round(total_mb / rdt, 1)}
+    log(f"  stream: write={out['seq_write_mbps']} read={out['seq_read_mbps']} MB/s")
+    return out
+
+
+def bench_smallfile(cluster, volume: str, n_files: int, size: int = 4096) -> dict:
+    """Tiny-file TPS (create+write+read of 4 KiB files — the tiny-extent
+    path; ref evaluation tiny.md)."""
+    from chubaofs_tpu.sdk.cluster import RemoteCluster
+
+    fs = RemoteCluster(cluster.master_addrs).client(volume)
+    fs.mkdirs("/small")
+    payload = b"s" * size
+    t0 = time.perf_counter()
+    for i in range(n_files):
+        ino = fs.create(f"/small/f{i}")
+        fs.write_at(ino, 0, payload)
+    wdt = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    for i in range(n_files):
+        assert len(fs.read_file(f"/small/f{i}")) == size
+    rdt = time.perf_counter() - t0
+    out = {"smallfile_write_tps": round(n_files / wdt, 1),
+           "smallfile_read_tps": round(n_files / rdt, 1)}
+    log(f"  smallfile: write={out['smallfile_write_tps']} "
+        f"read={out['smallfile_read_tps']} TPS")
+    return out
+
+
+def run(root: str, n_files: int = 600, n_clients: int = 4,
+        stream_mb: int = 64, metanodes: int = 3, datanodes: int = 3) -> dict:
+    from chubaofs_tpu.testing.harness import ProcCluster
+
+    cluster = ProcCluster(root, masters=1, metanodes=metanodes,
+                          datanodes=datanodes)
+    try:
+        cluster.client_master().create_volume("perf", cold=False)
+        cfg: dict = {}
+        log("metadata (mdtest analog)...")
+        cfg.update(bench_metadata(cluster, "perf", n_files, n_clients))
+        log("streaming (fio analog)...")
+        cfg.update(bench_stream(cluster, "perf", stream_mb))
+        log("small files (tiny.md analog)...")
+        cfg.update(bench_smallfile(cluster, "perf", max(100, n_files // 4)))
+        return cfg
+    finally:
+        cluster.close()
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="cfs-perfbench")
+    p.add_argument("--clients", type=int, default=4)
+    p.add_argument("--files", type=int, default=600)
+    p.add_argument("--stream-mb", type=int, default=64)
+    p.add_argument("--root", default="")
+    args = p.parse_args(argv)
+
+    root = args.root or tempfile.mkdtemp(prefix="cfsperf")
+    try:
+        cfg = run(root, n_files=args.files, n_clients=args.clients,
+                  stream_mb=args.stream_mb)
+    finally:
+        if not args.root:
+            shutil.rmtree(root, ignore_errors=True)
+    print(json.dumps({
+        "metric": "mdtest_create_ops",
+        "value": cfg.get(f"create_ops_{args.clients}c",
+                         cfg.get("create_ops_1c", 0.0)),
+        "unit": "ops/s",
+        "configs": cfg,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,44 @@
+"""Hot-path perf harness: runs end-to-end at tiny sizes + loose regression
+floors so a pathological slowdown (per-op reconnect, raft tick-gated
+proposes, accidental O(n^2) paths) fails the suite rather than silently
+rotting the PERF.md numbers. Floors are ~10x under the measured dev-host
+figures (PERF.md round-5 section) to stay robust on loaded CI hosts."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+
+def test_perfbench_tool_runs_and_gates(tmp_path):
+    # own session so a timeout kill reaps the 7 daemon GRANDCHILDREN too —
+    # subprocess.run's kill stops only the direct child, orphaning the
+    # ProcCluster (the leak class 426b988 hardened against)
+    p = subprocess.Popen(
+        [sys.executable, "-m", "chubaofs_tpu.tools.perfbench",
+         "--files", "60", "--clients", "2", "--stream-mb", "8",
+         "--root", str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        start_new_session=True)
+    try:
+        stdout, stderr = p.communicate(timeout=420)
+    finally:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)  # idempotent sweep
+        except (ProcessLookupError, PermissionError):
+            pass
+    assert p.returncode == 0, stderr[-2000:]
+    line = json.loads(stdout.strip().splitlines()[-1])
+    cfg = line["configs"]
+    assert line["metric"] == "mdtest_create_ops" and line["unit"] == "ops/s"
+    # regression floors (measured ~120/220/60/170 on the dev host)
+    assert cfg["create_ops_1c"] > 12, cfg
+    assert cfg["stat_ops_1c"] > 25, cfg
+    assert cfg["seq_write_mbps"] > 5, cfg
+    assert cfg["seq_read_mbps"] > 15, cfg
+    assert cfg["smallfile_write_tps"] > 6, cfg
