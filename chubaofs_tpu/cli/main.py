@@ -99,6 +99,25 @@ class CLI:
                   f"{z['used_space'] / gib:.1f}/{z['total_space'] / gib:.1f} GiB",
                   file=self.out)
 
+    def cluster_domains(self, args):
+        """zone -> fault domain assignments (domain mode when non-empty)."""
+        doms = self.mc.get_zone_domains()
+        if self.as_json:
+            return self._emit(doms)
+        if not doms:
+            print("domain mode off (no assignments)", file=self.out)
+            return
+        table([{"zone": z, "domain": d} for z, d in sorted(doms.items())],
+              ["zone", "domain"], self.out)
+
+    def cluster_setdomain(self, args):
+        res = self.mc.set_zone_domain(args.zone, args.domain)
+        if self.as_json:
+            return self._emit(res)
+        if res.get("warning"):
+            print(f"warning: {res['warning']}", file=self.out)
+        print(f"{len(res['domains'])} assignment(s)", file=self.out)
+
     def cluster_topology(self, args):
         """Zones -> nodesets -> nodes, rendered from the master's own
         topology view (`cfs-cli zone list` analog)."""
@@ -244,6 +263,11 @@ def build_parser() -> argparse.ArgumentParser:
     cluster.add_parser("info").set_defaults(fn="cluster_info")
     cluster.add_parser("topology").set_defaults(fn="cluster_topology")
     cluster.add_parser("stat").set_defaults(fn="cluster_stat")
+    cluster.add_parser("domains").set_defaults(fn="cluster_domains")
+    sd = cluster.add_parser("setdomain")
+    sd.add_argument("zone")
+    sd.add_argument("domain", help="empty string clears the assignment")
+    sd.set_defaults(fn="cluster_setdomain")
 
     vol = sub.add_parser("vol", aliases=["volume"]).add_subparsers(
         dest="verb", required=True)

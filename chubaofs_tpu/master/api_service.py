@@ -64,6 +64,8 @@ class MasterAPI:
         g("/admin/getCluster", self._w(self.get_cluster, leader=False))
         g("/admin/getClusterStat", self._w(self.get_cluster_stat, leader=False))
         g("/admin/getTopology", self._w(self.get_topology, leader=False))
+        g("/admin/getZoneDomains", self._w(self.get_zone_domains, leader=False))
+        g("/admin/setZoneDomain", self._w(self.set_zone_domain, admin=True))
         g("/admin/getIp", self._w(self.get_ip, leader=False))
         g("/admin/createVol", self._w(self.create_vol, admin=True))
         g("/admin/deleteVol", self._w(self.delete_vol, admin=True))
@@ -150,6 +152,26 @@ class MasterAPI:
 
     def get_ip(self, req: Request):
         return {"cluster": "chubaofs-tpu", "ip": req.remote}
+
+    def get_zone_domains(self, req: Request):
+        """zone -> fault domain map (master/topology.go:43 domain mode)."""
+        return dict(self.master.sm.zone_domains)
+
+    def set_zone_domain(self, req: Request):
+        zone = req.q("zone")
+        if not zone:
+            raise MasterError("missing ?zone")
+        # absent != blank: only an EXPLICIT domain= clears the assignment
+        # (a typo'd param name must not silently strip domain protection)
+        if not req.has_q("domain"):
+            raise MasterError("missing ?domain (pass domain= to clear)")
+        doms = self.master.set_zone_domain(zone, req.q("domain"))
+        known = {n.zone for n in self.master.sm.nodes.values()}
+        return {"domains": doms,
+                # a typo'd zone matches no node: report it so the operator
+                # doesn't walk away believing domain tolerance is on
+                "warning": ("" if zone in known else
+                            f"zone {zone!r} matches no registered node")}
 
     def create_vol(self, req: Request):
         name = req.q("name")
@@ -373,6 +395,13 @@ class MasterClient:
 
     def get_topology(self):
         return self.call("/admin/getTopology")
+
+    def get_zone_domains(self):
+        return self.call("/admin/getZoneDomains")
+
+    def set_zone_domain(self, zone: str, domain: str):
+        return self.call(self._path("/admin/setZoneDomain", zone=zone,
+                                    domain=domain))
 
     def create_volume(self, name: str, owner: str = "", cold: bool = False,
                       capacity: int = 1 << 40, dp_count: int = 3,

@@ -108,6 +108,10 @@ class MasterSM(StateMachine):
         self.volumes: dict[str, VolumeView] = {}
         self.users: dict[str, UserInfo] = {}  # user_id -> info
         self.ak_index: dict[str, str] = {}  # access_key -> user_id
+        # fault domains group zones (master/topology.go:43 + vol.go domain
+        # placement): any assignment turns domain mode ON; unassigned zones
+        # act as their own singleton domains
+        self.zone_domains: dict[str, str] = {}
         self.next_id = 100  # shared id space for volumes + partitions
 
     # raft hooks -------------------------------------------------------------
@@ -128,7 +132,8 @@ class MasterSM(StateMachine):
         from chubaofs_tpu.raft import snapcodec
 
         w = snapcodec.SnapshotWriter()
-        w.add("meta", {"next_id": self.next_id})
+        w.add("meta", {"next_id": self.next_id,
+                       "zone_domains": self.zone_domains})
         w.add_batched("nodes", (asdict(n) for n in self.nodes.values()))
         w.add_batched("volumes", (asdict(v) for v in self.volumes.values()))
         w.add_batched("users", (asdict(u) for u in self.users.values()))
@@ -138,6 +143,7 @@ class MasterSM(StateMachine):
         from chubaofs_tpu.raft import snapcodec
 
         self.nodes, self.volumes, self.users, self.ak_index = {}, {}, {}, {}
+        self.zone_domains = {}
 
         def load_nodes(batch):
             for d in batch:
@@ -163,8 +169,13 @@ class MasterSM(StateMachine):
                 self.users[u.user_id] = u
                 self.ak_index[u.access_key] = u.user_id
 
+        def load_meta(m):
+            self.next_id = m["next_id"]
+            # older snapshots predate fault domains
+            self.zone_domains = dict(m.get("zone_domains", {}))
+
         snapcodec.restore_sections(payload, {
-            "meta": lambda m: setattr(self, "next_id", m["next_id"]),
+            "meta": load_meta,
             "nodes": load_nodes,
             "volumes": load_volumes,
             "users": load_users,
@@ -202,6 +213,16 @@ class MasterSM(StateMachine):
             n.zone = zone
         n.last_heartbeat = max(n.last_heartbeat, now)
         return node_id
+
+    def _op_set_zone_domain(self, zone: str, domain: str):
+        """Assign a zone to a fault domain (master/topology.go:43). An empty
+        domain clears the assignment; clearing the last one turns domain
+        mode off."""
+        if domain:
+            self.zone_domains[zone] = domain
+        else:
+            self.zone_domains.pop(zone, None)
+        return dict(self.zone_domains)
 
     def _assign_nodeset(self, kind: str, zone: str) -> int:
         """Smallest zone-local nodeset with spare capacity — deterministic over
@@ -445,6 +466,10 @@ class Master:
         self._apply("register_node", node_id=node_id, kind=kind, addr=addr,
                     raft_addr=raft_addr, now=time.time(), zone=zone)
 
+    def set_zone_domain(self, zone: str, domain: str) -> dict:
+        """Assign/clear a zone's fault domain (replicated)."""
+        return self._apply("set_zone_domain", zone=zone, domain=domain)
+
     def topology(self) -> dict:
         """zones -> nodesets -> node ids (master/topology.go view analog)."""
         out: dict[str, dict[int, list[int]]] = {}
@@ -501,30 +526,54 @@ class Master:
 
     # -- volume admin -----------------------------------------------------------
 
+    def domain_of(self, zone: str) -> str:
+        """Fault domain owning a zone; unassigned zones are their own
+        singleton domains (reference default-domain behavior)."""
+        return self.sm.zone_domains.get(zone, zone)
+
     def _spread_by_zone(self, cands: list[NodeInfo], count: int,
-                        kind: str, prefer_zone: str | None = None) -> list[NodeInfo]:
-        """Zone-aware replica spread (master/topology.go placement contract):
-        with >= `count` zones, one replica per zone; with fewer, round-robin so
-        no zone holds two replicas before every zone holds one. `prefer_zone`
-        biases single-node picks (decommission replacements stay in the
-        victim's zone to preserve the spread)."""
+                        kind: str) -> list[NodeInfo]:
+        """Fault-domain- and zone-aware replica spread (master/topology.go
+        placement contract + vol.go domain mode): with domain assignments
+        present, replicas spread one-per-DOMAIN first — so a whole-domain
+        loss (power/network failure of several co-dependent zones) leaves
+        count-1 replicas when >= count domains exist — then per zone inside
+        each domain; without assignments, domains degenerate to zones and
+        the behavior is the plain zone spread. With fewer groups than
+        `count`, round-robin so no group holds two replicas before every
+        group holds one. (Decommission/dead-node replacements go through
+        _pick_replacement, which adds survivor-aware zone/domain bias.)"""
         if len(cands) < count:
             raise MasterError(f"need {count} {kind}nodes, have {len(cands)}")
         by_zone: dict[str, list[NodeInfo]] = {}
         for n in sorted(cands, key=lambda n: n.partition_count):
             by_zone.setdefault(n.zone, []).append(n)
-        if prefer_zone is not None and count == 1 and by_zone.get(prefer_zone):
-            return [by_zone[prefer_zone][0]]
-        zones = sorted(by_zone.values(), key=lambda ns: ns[0].partition_count)
+        # group zones into domains; inside a domain, zones interleave so the
+        # secondary spread (across zones within the picked domain) holds too
+        by_domain: dict[str, list[NodeInfo]] = {}
+        for zone, ns in by_zone.items():
+            by_domain.setdefault(self.domain_of(zone), []).append(ns)
+        groups = []
+        for zone_lists in by_domain.values():
+            zone_lists.sort(key=lambda ns: ns[0].partition_count)
+            merged: list[NodeInfo] = []
+            rank = 0
+            while any(rank < len(ns) for ns in zone_lists):
+                for ns in zone_lists:
+                    if rank < len(ns):
+                        merged.append(ns[rank])
+                rank += 1
+            groups.append(merged)
+        groups.sort(key=lambda ns: ns[0].partition_count)
         picked: list[NodeInfo] = []
-        if len(zones) >= count:
-            for ns in zones[:count]:
+        if len(groups) >= count:
+            for ns in groups[:count]:
                 picked.append(ns[0])
         else:
             rank = 0
             while len(picked) < count:
                 advanced = False
-                for ns in zones:
+                for ns in groups:
                     if rank < len(ns):
                         picked.append(ns[rank])
                         advanced = True
@@ -535,18 +584,46 @@ class Master:
                 rank += 1
         return picked
 
-    def _pick_meta_peers(self, count: int = 3, exclude: set[int] = frozenset(),
-                         prefer_zone: str | None = None) -> list[int]:
+    def _pick_replacement(self, kind: str, survivors: list[int],
+                          victim: int) -> NodeInfo:
+        """One replacement replica for a migrated partition member. The
+        victim's zone is preferred when it still has healthy nodes (a
+        decommission replacement preserves the existing spread by
+        construction); otherwise candidates rank by NOT sharing a fault
+        domain with any survivor, then not sharing a zone, then emptiest —
+        so a whole-domain loss re-homes into a domain that does not already
+        hold a replica (vol.go domain placement on the repair path)."""
+        exclude = set(survivors) | {victim}
+        cands = [n for n in self.sm.nodes.values()
+                 if n.kind == kind and n.schedulable
+                 and n.node_id not in exclude]
+        if not cands:
+            raise MasterError(f"need 1 {kind}node, have 0")
+        victim_zone = self.sm.nodes[victim].zone
+        in_zone = [n for n in cands if n.zone == victim_zone]
+        if in_zone:
+            return min(in_zone, key=lambda n: n.partition_count)
+        surv_zones = {self.sm.nodes[p].zone for p in survivors
+                      if p in self.sm.nodes}
+        surv_doms = {self.domain_of(z) for z in surv_zones}
+        return min(cands, key=lambda n: (
+            self.domain_of(n.zone) in surv_doms,
+            n.zone in surv_zones,
+            n.partition_count,
+        ))
+
+    def _pick_meta_peers(self, count: int = 3,
+                         exclude: set[int] = frozenset()) -> list[int]:
         metas = [n for n in self.sm.nodes.values()
                  if n.kind == "meta" and n.schedulable and n.node_id not in exclude]
         return [n.node_id
-                for n in self._spread_by_zone(metas, count, "meta", prefer_zone)]
+                for n in self._spread_by_zone(metas, count, "meta")]
 
-    def _pick_data_peers(self, count: int = 3, exclude: set[int] = frozenset(),
-                         prefer_zone: str | None = None) -> list[NodeInfo]:
+    def _pick_data_peers(self, count: int = 3,
+                         exclude: set[int] = frozenset()) -> list[NodeInfo]:
         datas = [n for n in self.sm.nodes.values()
                  if n.kind == "data" and n.schedulable and n.node_id not in exclude]
-        return self._spread_by_zone(datas, count, "data", prefer_zone)
+        return self._spread_by_zone(datas, count, "data")
 
     def create_volume(self, name: str, owner: str = "", capacity: int = 1 << 40,
                       cold: bool = False, data_partitions: int = 3,
@@ -680,10 +757,9 @@ class Master:
             for mp in vol.meta_partitions:
                 if node_id not in mp.peers:
                     continue
-                victim_zone = self.sm.nodes[node_id].zone
-                repl = self._pick_meta_peers(1, exclude=set(mp.peers),
-                                             prefer_zone=victim_zone)[0]
-                new_peers = [p for p in mp.peers if p != node_id] + [repl]
+                survivors = [p for p in mp.peers if p != node_id]
+                repl = self._pick_replacement("meta", survivors, node_id).node_id
+                new_peers = survivors + [repl]
                 if self.metanode_hook:
                     # replacement-only create with the final membership
                     self.metanode_hook(mp.partition_id, mp.start, mp.end,
@@ -716,9 +792,8 @@ class Master:
             for dp in vol.data_partitions:
                 if node_id not in dp.peers:
                     continue
-                repl = self._pick_data_peers(
-                    1, exclude=set(dp.peers),
-                    prefer_zone=self.sm.nodes[node_id].zone)[0]
+                repl = self._pick_replacement(
+                    "data", [p for p in dp.peers if p != node_id], node_id)
                 idx = dp.peers.index(node_id)
                 new_peers = [p for p in dp.peers if p != node_id] + [repl.node_id]
                 hosts = self._current_hosts(dp.peers, dp.hosts)

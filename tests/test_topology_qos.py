@@ -498,6 +498,146 @@ def test_liveness_leaves_decommissioned_alone(master):
     assert master.sm.nodes[victim].status == "decommissioned"
 
 
+# -- fault domains (master/topology.go:43, vol.go domain placement) -----------
+
+
+def _domain_of(master, node_id):
+    return master.domain_of(master.sm.nodes[node_id].zone)
+
+
+def test_domain_aware_placement_spreads_across_domains(master):
+    """With >= 3 domains (of 2 zones each), every 3-replica set lands one
+    replica per DOMAIN — a whole-domain loss leaves two replicas."""
+    _register_grid(master, "meta", zones=6, per_zone=1, base=100)
+    _register_grid(master, "data", zones=6, per_zone=1, base=200)
+    for z in range(6):
+        master.set_zone_domain(f"z{z}", f"d{z // 2}")  # d0={z0,z1}, ...
+
+    vol = master.create_volume("dv", data_partitions=4)
+    for mp in vol.meta_partitions:
+        assert len({_domain_of(master, p) for p in mp.peers}) == 3, mp.peers
+    for dp in vol.data_partitions:
+        assert len({_domain_of(master, p) for p in dp.peers}) == 3, dp.peers
+
+
+def test_domain_round_robin_with_two_domains(master):
+    """Fewer domains than replicas: no domain holds two replicas before
+    every domain holds one (the zone round-robin lifted to domains)."""
+    _register_grid(master, "meta", zones=4, per_zone=2, base=100)
+    _register_grid(master, "data", zones=4, per_zone=2, base=200)
+    for z in range(4):
+        master.set_zone_domain(f"z{z}", f"d{z % 2}")
+
+    vol = master.create_volume("dv2", data_partitions=3)
+    for dp in vol.data_partitions:
+        doms = [_domain_of(master, p) for p in dp.peers]
+        assert sorted(doms.count(d) for d in set(doms)) == [1, 2], doms
+        # the doubled domain still spreads its two replicas over two zones
+        for d in set(doms):
+            zs = [master.sm.nodes[p].zone for p in dp.peers
+                  if _domain_of(master, p) == d]
+            assert len(set(zs)) == len(zs), (d, zs)
+
+
+def test_domain_assignments_replicate_and_snapshot(tmp_path):
+    """zone_domains is raft state: it survives WAL replay + snapshot."""
+    net = InProcNet()
+    raft = MultiRaft(1, net, wal_dir=str(tmp_path / "dm"))
+    sm = MasterSM()
+    raft.create_group(MASTER_GROUP, [1], sm)
+    assert run_until(net, lambda: raft.is_leader(MASTER_GROUP))
+    m = Master(raft, sm)
+    m.set_zone_domain("za", "east")
+    m.set_zone_domain("zb", "west")
+    m.set_zone_domain("za", "")  # clear
+    blob = sm.snapshot()
+    sm2 = MasterSM()
+    sm2.restore(blob)
+    assert sm2.zone_domains == {"zb": "west"}
+
+
+def test_whole_domain_loss_tolerated_and_rehomed(master):
+    """Kill EVERY node of one domain: reads stay quorate (2/3 replicas
+    elsewhere by construction) and the dead-node sweep re-homes onto the
+    surviving domains."""
+    import time as _time
+
+    _register_grid(master, "meta", zones=3, per_zone=2, base=100)
+    _register_grid(master, "data", zones=3, per_zone=2, base=200)
+    for z in range(3):
+        master.set_zone_domain(f"z{z}", f"d{z}")
+    vol = master.create_volume("dl", data_partitions=2)
+
+    # every placement is one-replica-per-domain, so losing d0 leaves 2/3
+    dead = [n.node_id for n in master.sm.nodes.values()
+            if master.domain_of(n.zone) == "d0"]
+    now = _time.time()
+    for n in master.sm.nodes.values():
+        n.last_heartbeat = now
+    for nid in dead:
+        master.sm.nodes[nid].last_heartbeat = now - 120
+    for dp in vol.data_partitions:
+        alive = [p for p in dp.peers if p not in dead]
+        assert len(alive) == 2, dp.peers
+
+    # dead-node sweep re-homes the lost replicas into surviving domains
+    assert set(master.check_node_liveness(timeout=10.0, now=now)) <= set(dead)
+    moved = master.check_dead_node_replicas(dead_after=60.0, now=now)
+    assert moved >= 1
+    vol = master.get_volume("dl")
+    for dp in vol.data_partitions:
+        assert not set(dp.peers) & set(dead), dp.peers
+        assert len({_domain_of(master, p) for p in dp.peers}) == 2
+
+
+@pytest.mark.parametrize("seed", [5, 6])
+def test_domain_loss_soak(master, seed):
+    """Randomized domain-fault soak (the master-plane analog of the
+    blobstore's dark-AZ soak): a seeded schedule kills and revives whole
+    fault domains; after every sweep, each partition keeps >= 2 live
+    replicas, and whenever >= 3 domains are healthy, no partition
+    co-locates two replicas in one domain."""
+    import random as _random
+    import time as _time
+
+    rnd = _random.Random(seed)
+    _register_grid(master, "meta", zones=4, per_zone=2, base=100)
+    _register_grid(master, "data", zones=4, per_zone=2, base=200)
+    for z in range(4):
+        master.set_zone_domain(f"z{z}", f"d{z}")
+    vol = master.create_volume("soak", data_partitions=3)
+    now = _time.time()
+    dark: set[str] = set()
+
+    for _ in range(10):
+        action = rnd.choice(["kill", "revive", "none"])
+        if action == "kill" and len(dark) < 2:
+            dark.add(rnd.choice([f"d{z}" for z in range(4)]))
+        elif action == "revive" and dark:
+            dark.discard(rnd.choice(sorted(dark)))
+        now += 300
+        for n in master.sm.nodes.values():
+            if master.domain_of(n.zone) not in dark:
+                n.last_heartbeat = now
+                if n.status == "inactive":
+                    master.heartbeat(n.node_id)
+        master.check_node_liveness(timeout=10.0, now=now)
+        master.check_data_partitions()
+        master.check_dead_node_replicas(dead_after=60.0, now=now)
+
+        vol = master.get_volume("soak")
+        dead_nodes = {n.node_id for n in master.sm.nodes.values()
+                      if master.domain_of(n.zone) in dark}
+        healthy_domains = 4 - len(dark)
+        for dp in vol.data_partitions:
+            live = [p for p in dp.peers if p not in dead_nodes]
+            assert len(live) >= 2, (dark, dp.peers)
+            if healthy_domains >= 3:
+                doms = [_domain_of(master, p) for p in dp.peers
+                        if p not in dead_nodes]
+                assert len(set(doms)) == len(doms), (dark, dp.peers)
+
+
 def test_cluster_stat_rollup(master):
     """Space/health rollup from heartbeat reports (scheduleToUpdateStatInfo +
     /admin/getClusterStat analog), per zone and cluster-wide."""
