@@ -143,6 +143,14 @@ class CLI:
                                   dp_count=args.dp_count)
         self._emit(v)
 
+    def vol_update(self, args):
+        fr = None if args.follower_read is None else args.follower_read == "true"
+        v = self.mc.update_volume(
+            args.name, capacity=args.capacity, follower_read=fr,
+            qos_read_mbps=args.qos_read_mbps,
+            qos_write_mbps=args.qos_write_mbps)
+        self._emit(v)
+
     def vol_list(self, args):
         vols = self.mc.list_volumes()
         self._emit(vols, rows=vols,
@@ -279,6 +287,13 @@ def build_parser() -> argparse.ArgumentParser:
     c.add_argument("--capacity", type=int, default=1 << 40)
     c.add_argument("--dp-count", type=int, default=3)
     c.set_defaults(fn="vol_create")
+    u = vol.add_parser("update")
+    u.add_argument("name")
+    u.add_argument("--capacity", type=int, default=None)
+    u.add_argument("--follower-read", choices=["true", "false"], default=None)
+    u.add_argument("--qos-read-mbps", type=int, default=None)
+    u.add_argument("--qos-write-mbps", type=int, default=None)
+    u.set_defaults(fn="vol_update")
     vol.add_parser("list").set_defaults(fn="vol_list")
     i = vol.add_parser("info")
     i.add_argument("name")

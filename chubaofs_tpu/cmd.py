@@ -359,6 +359,17 @@ class MasterDaemon(_Daemon):
         self.master.check_data_partitions()
         # durable repair: replicas on long-dead nodes re-home to healthy peers
         self.master.check_dead_node_replicas(dead_after=self.dead_node_secs)
+        # under-replicated partitions (partial migrations) gain replacements
+        self.master.ensure_replica_counts()
+        # long-silent drained nodes leave the registry
+        self.master.prune_stale_nodes(stale_after=60 * self.dead_node_secs)
+        # partitions a node reports but no volume records: failed deletes/
+        # migrations — send remove tasks (junk-task cleanup analog)
+        for node_id, pids in self.master.orphan_partitions().items():
+            n = self.sm.nodes.get(node_id)
+            kind = n.kind if n else "data"
+            for pid in pids:
+                self._remove_partition_hook(kind, pid, node_id)
         now = time.time()
         for vol in list(self.sm.volumes.values()):
             for mp in vol.meta_partitions:

@@ -300,14 +300,22 @@ class FsCluster:
         self.master().delete_volume(name)
 
     def client(self, volume: str) -> FsClient:
+        from chubaofs_tpu.sdk.fs import VolQos
+
         meta = MetaWrapper(self.master(), self.metanodes, volume)
         vol = self.master().get_volume(volume)
+
+        def fetch_limits():
+            v = self.master().get_volume(volume)
+            return v.qos_read_mbps, v.qos_write_mbps
+
+        qos = VolQos.from_view(vol, fetch=fetch_limits)
         if vol.cold:
-            return FsClient(meta, self.data_backend, cold=True)
+            return FsClient(meta, self.data_backend, cold=True, qos=qos)
         ec = ExtentClient(lambda: self.master().data_partition_views(volume),
                           follower_read=vol.follower_read)
         return FsClient(meta, self.data_backend, hot_backend=HotBackend(ec, meta),
-                        cold=False)
+                        cold=False, qos=qos)
 
     def close(self):
         for dn in self.datanodes.values():

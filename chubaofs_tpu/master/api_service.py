@@ -68,6 +68,7 @@ class MasterAPI:
         g("/admin/setZoneDomain", self._w(self.set_zone_domain, admin=True))
         g("/admin/getIp", self._w(self.get_ip, leader=False))
         g("/admin/createVol", self._w(self.create_vol, admin=True))
+        g("/admin/updateVol", self._w(self.update_vol, admin=True))
         g("/admin/deleteVol", self._w(self.delete_vol, admin=True))
         g("/admin/getVol", self._w(self.get_vol, leader=False))
         g("/admin/listVols", self._w(self.list_vols, leader=False))
@@ -187,6 +188,24 @@ class MasterAPI:
         )
         if owner and owner in self.master.sm.users:
             self.master.set_vol_owner(owner, name, add=True)
+        return self._vol_view(vol)
+
+    def update_vol(self, req: Request):
+        """Vol expand/shrink + option/QoS updates (ref /vol/update)."""
+        name = req.q("name")
+        if not name:
+            raise MasterError("missing ?name")
+
+        def opt_int(key):
+            return int(req.q(key)) if req.has_q(key) else None
+
+        fr = None
+        if req.has_q("followerRead"):
+            fr = req.q("followerRead") == "true"
+        vol = self.master.update_volume(
+            name, capacity=opt_int("capacity"), follower_read=fr,
+            qos_read_mbps=opt_int("qosReadMbps"),
+            qos_write_mbps=opt_int("qosWriteMbps"))
         return self._vol_view(vol)
 
     def delete_vol(self, req: Request):
@@ -411,6 +430,21 @@ class MasterClient:
             cold="true" if cold else "false", capacity=capacity,
             dpCount=dp_count,
             followerRead="true" if follower_read else "false"))
+
+    def update_volume(self, name: str, capacity: int | None = None,
+                      follower_read: bool | None = None,
+                      qos_read_mbps: int | None = None,
+                      qos_write_mbps: int | None = None):
+        args = {"name": name}
+        if capacity is not None:
+            args["capacity"] = capacity
+        if follower_read is not None:
+            args["followerRead"] = "true" if follower_read else "false"
+        if qos_read_mbps is not None:
+            args["qosReadMbps"] = qos_read_mbps
+        if qos_write_mbps is not None:
+            args["qosWriteMbps"] = qos_write_mbps
+        return self.call(self._path("/admin/updateVol", **args))
 
     def delete_volume(self, name: str):
         return self.call(self._path("/admin/deleteVol", name=name))

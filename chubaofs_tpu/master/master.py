@@ -83,6 +83,12 @@ class VolumeView:
     # the leader's latest random overwrite); ref proto/mount_options.go
     # FollowerRead + sdk/data/stream follower-read
     follower_read: bool = False
+    # per-volume client QoS (MB/s, 0 = unlimited): the master owns the
+    # limits and every client reads them from its volume view, so an
+    # operator change flows master -> clients on the next view refresh
+    # (ref master/limiter.go qos assignment flowing to clients)
+    qos_read_mbps: int = 0
+    qos_write_mbps: int = 0
     meta_partitions: list[MetaPartitionView] = field(default_factory=list)
     data_partitions: list[DataPartitionView] = field(default_factory=list)
 
@@ -156,6 +162,10 @@ class MasterSM(StateMachine):
                 v = VolumeView(
                     name=d["name"], vol_id=d["vol_id"], owner=d["owner"],
                     capacity=d["capacity"], cold=d["cold"],
+                    # .get: snapshots from before each option existed
+                    follower_read=d.get("follower_read", False),
+                    qos_read_mbps=d.get("qos_read_mbps", 0),
+                    qos_write_mbps=d.get("qos_write_mbps", 0),
                     meta_partitions=[MetaPartitionView(**m)
                                      for m in d["meta_partitions"]],
                     data_partitions=[DataPartitionView(**p)
@@ -276,6 +286,45 @@ class MasterSM(StateMachine):
             if p in self.nodes:
                 self.nodes[p].partition_count += 1
         return vol
+
+    def _op_update_volume(self, name: str, capacity: int | None = None,
+                          follower_read: bool | None = None,
+                          qos_read_mbps: int | None = None,
+                          qos_write_mbps: int | None = None):
+        """Vol expand/shrink + option updates (master/vol.go updateVol).
+        Capacity is an admin quota: usage enforcement stays with the
+        write-time quota charges, so shrinking below current usage stops
+        NEW growth rather than deleting data (the reference's semantics)."""
+        vol = self.volumes.get(name)
+        if vol is None:
+            raise MasterError(f"unknown volume {name!r}")
+        if capacity is not None:
+            if capacity <= 0:
+                raise MasterError("capacity must be positive")
+            vol.capacity = int(capacity)
+        if follower_read is not None:
+            vol.follower_read = bool(follower_read)
+        if qos_read_mbps is not None:
+            vol.qos_read_mbps = max(0, int(qos_read_mbps))
+        if qos_write_mbps is not None:
+            vol.qos_write_mbps = max(0, int(qos_write_mbps))
+        return vol
+
+    def _op_remove_node(self, node_id: int):
+        """Prune a registry entry (stale-node pruner); refuses while any
+        partition still lists the node."""
+        n = self.nodes.get(node_id)
+        if n is None:
+            return None
+        for vol in self.volumes.values():
+            for mp in vol.meta_partitions:
+                if node_id in mp.peers:
+                    raise MasterError(f"node {node_id} still hosts mp")
+            for dp in vol.data_partitions:
+                if node_id in dp.peers:
+                    raise MasterError(f"node {node_id} still hosts dp")
+        del self.nodes[node_id]
+        return node_id
 
     def _op_split_partition(self, vol_name: str, partition_id: int, split_at: int,
                             new_partition_id: int, peers: list[int]):
@@ -542,7 +591,7 @@ class Master:
         the behavior is the plain zone spread. With fewer groups than
         `count`, round-robin so no group holds two replicas before every
         group holds one. (Decommission/dead-node replacements go through
-        _pick_replacement, which adds survivor-aware zone/domain bias.)"""
+        _pick_addition, which adds survivor-aware zone/domain bias.)"""
         if len(cands) < count:
             raise MasterError(f"need {count} {kind}nodes, have {len(cands)}")
         by_zone: dict[str, list[NodeInfo]] = {}
@@ -584,25 +633,29 @@ class Master:
                 rank += 1
         return picked
 
-    def _pick_replacement(self, kind: str, survivors: list[int],
-                          victim: int) -> NodeInfo:
-        """One replacement replica for a migrated partition member. The
-        victim's zone is preferred when it still has healthy nodes (a
-        decommission replacement preserves the existing spread by
-        construction); otherwise candidates rank by NOT sharing a fault
-        domain with any survivor, then not sharing a zone, then emptiest —
-        so a whole-domain loss re-homes into a domain that does not already
-        hold a replica (vol.go domain placement on the repair path)."""
-        exclude = set(survivors) | {victim}
+    def _pick_addition(self, kind: str, survivors: list[int],
+                       prefer_zone: str | None = None,
+                       exclude: set[int] = frozenset()) -> NodeInfo:
+        """One extra replica for a partition that keeps `survivors`. With
+        `prefer_zone` (a migration victim's zone) still healthy, stay there —
+        the replacement preserves the existing spread by construction.
+        Otherwise candidates rank by NOT sharing a fault domain with any
+        survivor, then not sharing a zone, then emptiest — so whole-domain
+        losses re-home (and under-replication heals) into a domain/zone that
+        does not already hold a replica (vol.go domain placement on the
+        repair path). `exclude` bars extra nodes (the migration VICTIM) from
+        candidacy WITHOUT counting them in the spread ranking: the victim's
+        domain is exactly where a replica is no longer held."""
+        barred = set(survivors) | set(exclude)
         cands = [n for n in self.sm.nodes.values()
                  if n.kind == kind and n.schedulable
-                 and n.node_id not in exclude]
+                 and n.node_id not in barred]
         if not cands:
             raise MasterError(f"need 1 {kind}node, have 0")
-        victim_zone = self.sm.nodes[victim].zone
-        in_zone = [n for n in cands if n.zone == victim_zone]
-        if in_zone:
-            return min(in_zone, key=lambda n: n.partition_count)
+        if prefer_zone is not None:
+            in_zone = [n for n in cands if n.zone == prefer_zone]
+            if in_zone:
+                return min(in_zone, key=lambda n: n.partition_count)
         surv_zones = {self.sm.nodes[p].zone for p in survivors
                       if p in self.sm.nodes}
         surv_doms = {self.domain_of(z) for z in surv_zones}
@@ -758,7 +811,9 @@ class Master:
                 if node_id not in mp.peers:
                     continue
                 survivors = [p for p in mp.peers if p != node_id]
-                repl = self._pick_replacement("meta", survivors, node_id).node_id
+                repl = self._pick_addition(
+                    "meta", survivors, exclude={node_id},
+                    prefer_zone=self.sm.nodes[node_id].zone).node_id
                 new_peers = survivors + [repl]
                 if self.metanode_hook:
                     # replacement-only create with the final membership
@@ -792,8 +847,10 @@ class Master:
             for dp in vol.data_partitions:
                 if node_id not in dp.peers:
                     continue
-                repl = self._pick_replacement(
-                    "data", [p for p in dp.peers if p != node_id], node_id)
+                repl = self._pick_addition(
+                    "data", [p for p in dp.peers if p != node_id],
+                    exclude={node_id},
+                    prefer_zone=self.sm.nodes[node_id].zone)
                 idx = dp.peers.index(node_id)
                 new_peers = [p for p in dp.peers if p != node_id] + [repl.node_id]
                 hosts = self._current_hosts(dp.peers, dp.hosts)
@@ -941,6 +998,115 @@ class Master:
                 if remaining == 0:
                     self._dead_drained.add(n.node_id)
         return moved
+
+    def update_volume(self, name: str, capacity: int | None = None,
+                      follower_read: bool | None = None,
+                      qos_read_mbps: int | None = None,
+                      qos_write_mbps: int | None = None) -> VolumeView:
+        """Vol expand/shrink + per-volume client QoS (master/vol.go
+        updateVol; limits flow master -> client via the volume view)."""
+        return self._apply(
+            "update_volume", name=name, capacity=capacity,
+            follower_read=follower_read, qos_read_mbps=qos_read_mbps,
+            qos_write_mbps=qos_write_mbps)
+
+    def ensure_replica_counts(self, target: int = 3) -> int:
+        """Partition-replica-count checker (scheduleToCheckDataReplicas'
+        under-replication half): any mp/dp below `target` peers gains a
+        replacement via the migrate machinery. Partial migrations and
+        operator surgery leave these behind; the sweep heals them."""
+        if not self.is_leader:
+            return 0
+        added = 0
+        for vol in list(self.sm.volumes.values()):
+            for mp in vol.meta_partitions:
+                while len(mp.peers) < target:
+                    try:
+                        repl = self._pick_addition("meta", mp.peers).node_id
+                    except MasterError:
+                        break  # not enough healthy nodes; retried next sweep
+                    new_peers = mp.peers + [repl]
+                    if self.metanode_hook:
+                        self.metanode_hook(mp.partition_id, mp.start, mp.end,
+                                           new_peers, only=repl)
+                    if self.raft_config_hook:
+                        self.raft_config_hook("meta", mp.partition_id, "add",
+                                              repl, mp.peers)
+                    self._apply("update_mp_peers", vol_name=vol.name,
+                                partition_id=mp.partition_id, peers=new_peers)
+                    mp = [m for m in self.sm.volumes[vol.name].meta_partitions
+                          if m.partition_id == mp.partition_id][0]
+                    added += 1
+            for dp in vol.data_partitions:
+                while len(dp.peers) < target:
+                    try:
+                        repl = self._pick_addition("data", dp.peers)
+                    except MasterError:
+                        break
+                    new_peers = dp.peers + [repl.node_id]
+                    new_hosts = self._current_hosts(dp.peers, dp.hosts) + [repl.addr]
+                    if self.datanode_hook:
+                        self.datanode_hook(dp.partition_id, new_peers,
+                                           new_hosts, only=repl.node_id)
+                    if self.raft_config_hook:
+                        self.raft_config_hook("data", dp.partition_id, "add",
+                                              repl.node_id, dp.peers)
+                    self._apply("update_dp_members", vol_name=vol.name,
+                                partition_id=dp.partition_id, peers=new_peers,
+                                hosts=new_hosts)
+                    dp = [d for d in self.sm.volumes[vol.name].data_partitions
+                          if d.partition_id == dp.partition_id][0]
+                    added += 1
+        return added
+
+    def prune_stale_nodes(self, stale_after: float = 3600.0,
+                          now: float | None = None) -> list[int]:
+        """Stale-node pruner: registry entries that are inactive or
+        decommissioned, host NO partition replicas, and have been silent
+        past `stale_after` are removed — a re-registration starts clean.
+        (The reference's operator-driven node removal, automated for the
+        already-drained case.)"""
+        if not self.is_leader:
+            return []
+        now = time.time() if now is None else now
+        pruned = []
+        for n in list(self.sm.nodes.values()):
+            if n.status == "active":
+                continue
+            if now - n.last_heartbeat < stale_after:
+                continue
+            if self._replica_count(n.node_id):
+                continue
+            try:
+                self._apply("remove_node", node_id=n.node_id)
+                self._dead_drained.discard(n.node_id)
+                pruned.append(n.node_id)
+            except MasterError:
+                pass
+        return pruned
+
+    def orphan_partitions(self) -> dict[int, list[int]]:
+        """node_id -> partition ids the node REPORTS (heartbeat cursors)
+        but should not host: either no volume records the pid (failed
+        volume delete) or the pid's recorded peer set no longer includes
+        the node (a migration whose remove task never reached the then-dead
+        victim). Per-NODE detection, so stale replicas left behind by
+        re-homes are found, not just fully-deleted-volume leftovers. The
+        daemon's sweep sends remove tasks for them (scheduleTask junk
+        cleanup analog)."""
+        peers_of: dict[int, set[int]] = {}
+        for vol in self.sm.volumes.values():
+            for mp in vol.meta_partitions:
+                peers_of[mp.partition_id] = set(mp.peers)
+            for dp in vol.data_partitions:
+                peers_of[dp.partition_id] = set(dp.peers)
+        out: dict[int, list[int]] = {}
+        for n in self.sm.nodes.values():
+            orphans = [pid for pid in n.cursors
+                       if n.node_id not in peers_of.get(pid, frozenset())]
+            if orphans:
+                out[n.node_id] = sorted(orphans)
+        return out
 
     def refresh_leaders(self, leader_of) -> None:
         """Record partition leaders into the view (client routing hint)."""

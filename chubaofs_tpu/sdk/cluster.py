@@ -38,7 +38,9 @@ class _MasterAdapter:
         d = self.mc.get_volume(name)
         vol = VolumeView(name=d["name"], vol_id=d["vol_id"], owner=d["owner"],
                          capacity=d["capacity"], cold=d["cold"],
-                         follower_read=d.get("follower_read", False))
+                         follower_read=d.get("follower_read", False),
+                         qos_read_mbps=d.get("qos_read_mbps", 0),
+                         qos_write_mbps=d.get("qos_write_mbps", 0))
         for mp in d["meta_partitions"]:
             end = INF if mp["end"] < 0 else mp["end"]
             vol.meta_partitions.append(MetaPartitionView(
@@ -115,12 +117,20 @@ class RemoteCluster:
         return sorted(self.mc.get_cluster()["volumes"])
 
     def client(self, volume: str) -> FsClient:
+        from chubaofs_tpu.sdk.fs import VolQos
+
         meta = MetaWrapper(self.adapter, self.metanode_handles(), volume)
         vol = self.adapter.get_volume(volume)
         backend = self.data_backend if self.access_addrs else None
+
+        def fetch_limits():
+            v = self.adapter.get_volume(volume)
+            return v.qos_read_mbps, v.qos_write_mbps
+
+        qos = VolQos.from_view(vol, fetch=fetch_limits)
         if vol.cold:
-            return FsClient(meta, backend, cold=True)
+            return FsClient(meta, backend, cold=True, qos=qos)
         ec = ExtentClient(lambda: self.mc.data_partitions(volume),
                           follower_read=vol.follower_read)
         return FsClient(meta, backend, hot_backend=HotBackend(ec, meta),
-                        cold=False)
+                        cold=False, qos=qos)

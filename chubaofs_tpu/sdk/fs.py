@@ -28,9 +28,78 @@ class FsError(Exception):
         self.code = code
 
 
+class VolQos:
+    """Per-volume client throttle, rates owned by the MASTER (volume view
+    qos_read_mbps/qos_write_mbps; ref master/limiter.go assignment flowing
+    to clients). Shapes rather than rejects: callers block until tokens
+    arrive. 0 = unlimited. With a `fetch` closure (() -> (read_mbps,
+    write_mbps), normally a master get_volume call), limits RE-ARM every
+    REFRESH_SECS from the data path — so tightening QoS on a misbehaving
+    tenant reaches live clients without rebuilding them, at one metadata
+    call per interval."""
+
+    REFRESH_SECS = 30.0
+
+    def __init__(self, read_mbps: int = 0, write_mbps: int = 0, fetch=None):
+        import time as _time
+
+        from chubaofs_tpu.utils.ratelimit import TokenBucket
+
+        self.read = TokenBucket(read_mbps * (1 << 20))
+        self.write = TokenBucket(write_mbps * (1 << 20))
+        self._fetch = fetch
+        self._next_fetch = _time.monotonic() + self.REFRESH_SECS
+
+    @classmethod
+    def from_view(cls, vol, fetch=None) -> "VolQos | None":
+        """The one construction policy for both local and remote clients:
+        with a fetch closure, always build (an unlimited volume may gain
+        limits later); without one, only when a limit is set now."""
+        if fetch is None and not (vol.qos_read_mbps or vol.qos_write_mbps):
+            return None
+        return cls(vol.qos_read_mbps, vol.qos_write_mbps, fetch=fetch)
+
+    def refresh(self, read_mbps: int, write_mbps: int) -> None:
+        self.read.rate = float(read_mbps * (1 << 20))
+        self.read.burst = max(self.read.rate, 1.0)
+        self.write.rate = float(write_mbps * (1 << 20))
+        self.write.burst = max(self.write.rate, 1.0)
+
+    def _maybe_refetch(self) -> None:
+        import time as _time
+
+        if self._fetch is None or _time.monotonic() < self._next_fetch:
+            return
+        self._next_fetch = _time.monotonic() + self.REFRESH_SECS
+        try:
+            r, w = self._fetch()
+            self.refresh(r, w)
+        except Exception:
+            pass  # keep the last-known limits through master hiccups
+
+    def _charge(self, bucket, nbytes: int) -> None:
+        self._maybe_refetch()
+        if bucket.rate <= 0:
+            return  # unlimited: never loop per-byte against a 1-token burst
+        # charge in burst-sized chunks: one huge IO must pay for ALL its
+        # bytes (a single clamped acquire would let any write <= burst
+        # through untouched), while still never requesting more than the
+        # bucket can physically accrue
+        while nbytes > 0:
+            take = min(nbytes, bucket.burst)
+            bucket.acquire(take)
+            nbytes -= int(take)
+
+    def throttle_read(self, nbytes: int) -> None:
+        self._charge(self.read, nbytes)
+
+    def throttle_write(self, nbytes: int) -> None:
+        self._charge(self.write, nbytes)
+
+
 class FsClient:
     def __init__(self, meta: MetaWrapper, data_backend, hot_backend=None,
-                 cold: bool = True, bcache=None):
+                 cold: bool = True, bcache=None, qos: "VolQos | None" = None):
         """Cold volumes: data_backend implements write(data)->location_json,
         read(location_json, offset, size)->bytes, delete(location_json).
         Hot volumes: hot_backend is a chubaofs_tpu.sdk.stream.HotBackend
@@ -45,6 +114,7 @@ class FsClient:
         self.hot = hot_backend
         self.cold = cold or hot_backend is None
         self.bcache = bcache
+        self.qos = qos  # master-assigned per-volume throttle (VolQos)
 
     # -- path resolution --------------------------------------------------------
 
@@ -173,6 +243,8 @@ class FsClient:
 
     def write_at(self, ino: int, offset: int, data: bytes) -> None:
         """Positional write, tier-dispatched (file.go:367-439 Write analog)."""
+        if self.qos is not None:
+            self.qos.throttle_write(len(data))
         try:
             if not self.cold:
                 self.hot.write(ino, offset, data)
@@ -198,6 +270,8 @@ class FsClient:
         if size is None:
             size = inode.size - offset
         size = max(0, min(size, inode.size - offset))
+        if self.qos is not None and size:
+            self.qos.throttle_read(size)
         if not self.cold:
             return self.hot.read(inode.ino, offset, size)
         out = bytearray()
