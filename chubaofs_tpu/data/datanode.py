@@ -20,6 +20,7 @@ replays extent deletes + tiny punch-hole records."""
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 import shutil
@@ -172,11 +173,22 @@ class SpaceManager:
 class DataNode:
     """TCP packet server + partitions + repair loops."""
 
+    # repair/migrate traffic class: bulk streams that must never starve
+    # client IO. The reference isolates them on separate smux ports
+    # (datanode/server.go:99-103); here the same isolation is an explicit
+    # PRIORITY LANE — repair-class packets share a small concurrency budget
+    # per node, so any repair fan-in queues against itself while client
+    # reads/writes keep their own unthrottled threads.
+    REPAIR_CLASS = frozenset({OP_REPAIR_READ, OP_REPAIR_WRITE,
+                              OP_GET_WATERMARKS})
+
     def __init__(self, node_id: int, addr: str, disks: list[str],
-                 raft: MultiRaft | None = None):
+                 raft: MultiRaft | None = None, repair_lanes: int = 2):
         self.node_id = node_id
         self.space = SpaceManager(disks)
         self.raft = raft
+        self.repair_lanes = repair_lanes
+        self._repair_sem = threading.BoundedSemaphore(repair_lanes)
         self.server = ReplServer(addr, self._dispatch)
         self.space.load_all(raft)
 
@@ -197,8 +209,13 @@ class DataNode:
             handler = self._HANDLERS[pkt.opcode]
         except KeyError:
             return pkt.reply(RES_ERR, arg={"error": f"bad opcode {pkt.opcode:#x}"})
+        # repair lane: bulk repair queues against its own budget, never
+        # against client IO (smux-port separation analog)
+        lane = (self._repair_sem if pkt.opcode in self.REPAIR_CLASS
+                else contextlib.nullcontext())
         try:
-            return handler(self, pkt)
+            with lane:
+                return handler(self, pkt)
         except ExtentNotFound as e:
             return pkt.reply(RES_NOT_EXIST, arg={"error": str(e)})
         except FollowerAckError as e:
@@ -458,7 +475,13 @@ class DataNode:
 
     def _stream_repair_extent(self, dp: DataPartition, eid: int, source: str,
                               dest: str, start: int, end: int) -> int:
-        """streamRepairExtent (data_partition_repair.go:481): chunked copy."""
+        """streamRepairExtent (data_partition_repair.go:481): chunked copy.
+
+        LOCAL chunk IO (this node is the source and/or dest — the common
+        case, the coordinator is usually the most advanced replica) takes
+        the repair lane the same as remote-origin repair packets do: the
+        traffic-class budget bounds bulk repair at the DISK, not merely at
+        the wire."""
         moved = 0
         pos = start
         while pos < end:
@@ -466,7 +489,8 @@ class DataNode:
             req = Packet(OP_REPAIR_READ, partition_id=dp.pid, extent_id=eid,
                          extent_offset=pos, arg={"size": n})
             if source == self.addr:
-                blob = dp.store.read(eid, pos, n)
+                with self._repair_sem:
+                    blob = dp.store.read(eid, pos, n)
             else:
                 rep = self.server.request(source, req)
                 if rep.result != RES_OK:
@@ -475,7 +499,8 @@ class DataNode:
             wr = Packet(OP_REPAIR_WRITE, partition_id=dp.pid, extent_id=eid,
                         extent_offset=pos, data=blob)
             if dest == self.addr:
-                self._op_repair_write(wr)
+                with self._repair_sem:
+                    self._op_repair_write(wr)
             else:
                 rep = self.server.request(dest, wr)
                 if rep.result != RES_OK:

@@ -325,3 +325,78 @@ class TestLeaderReadGate:
                 extent_offset=0, arg={"size": 4}))
             assert got.result == RES_OK and got.data == b"gate"
         assert leaders == 1
+
+
+class TestRepairTrafficClass:
+    def test_repair_lane_bounds_concurrency_client_io_unblocked(self, trio):
+        """Traffic-class separation (ref datanode/server.go:99-103 smux
+        ports, rebuilt as a priority lane): saturating the repair lane with
+        slow bulk reads (a) never admits more than repair_lanes concurrent
+        repair ops, and (b) leaves client STREAM_READ latency untouched."""
+        import threading as _threading
+        import time as _time
+
+        from chubaofs_tpu.utils.conn_pool import ConnPool
+
+        nodes, hosts, pool, net = trio
+        # the raft leader serves client stream reads; aim everything there
+        leader_dn = next(dn for dn in nodes
+                         if dn.space.partitions[10].is_raft_leader)
+        laddr = leader_dn.addr
+        eid_rep = _rpc(pool, laddr, Packet(
+            OP_CREATE_EXTENT, partition_id=10,
+            arg={"followers": [h for h in hosts if h != laddr]}))
+        eid = eid_rep.extent_id
+        _rpc(pool, laddr, Packet(
+            OP_WRITE, partition_id=10, extent_id=eid, extent_offset=0,
+            data=b"lane", arg={"followers": [h for h in hosts if h != laddr]}))
+
+        store = leader_dn.space.partitions[10].store
+        orig_read = store.read
+        inflight, peak = [0], [0]
+        gate = _threading.Lock()
+
+        def slow_read(eid_, off, size, **kw):
+            with gate:
+                inflight[0] += 1
+                peak[0] = max(peak[0], inflight[0])
+            _time.sleep(0.4)
+            try:
+                return orig_read(eid_, off, size, **kw)
+            finally:
+                with gate:
+                    inflight[0] -= 1
+
+        store.read = slow_read
+        try:
+            def repair_req():
+                p = ConnPool()
+                try:
+                    _rpc(p, laddr, Packet(
+                        OP_REPAIR_READ, partition_id=10, extent_id=eid,
+                        extent_offset=0, arg={"size": 4}))
+                finally:
+                    p.close()
+
+            threads = [_threading.Thread(target=repair_req)
+                       for _ in range(6)]
+            for t in threads:
+                t.start()
+            _time.sleep(0.3)  # lane saturated: 2 running, 4 queued
+            # client read on its own connection answers fast DESPITE the
+            # saturated repair lane (it also runs the slow store.read once,
+            # so "fast" = one read's latency, not the 6-deep repair queue)
+            t0 = _time.perf_counter()
+            got = _rpc(pool, laddr, Packet(
+                OP_STREAM_READ, partition_id=10, extent_id=eid,
+                extent_offset=0, arg={"size": 4}))
+            dt = _time.perf_counter() - t0
+            assert got.result == RES_OK and got.data == b"lane"
+            assert dt < 1.0, f"client IO starved behind repair queue ({dt:.2f}s)"
+            for t in threads:
+                t.join(timeout=10)
+            assert peak[0] <= leader_dn.repair_lanes + 1, (
+                f"repair concurrency {peak[0]} exceeded the lane budget "
+                f"(+1 for the client read sharing the patched store)")
+        finally:
+            store.read = orig_read
