@@ -36,6 +36,7 @@ from chubaofs_tpu.blobstore.clustermgr import ClusterMgr, VolumeInfo
 from chubaofs_tpu.blobstore.proxy import Proxy
 from chubaofs_tpu.codec.codemode import CodeMode, get_tactic
 from chubaofs_tpu.codec.service import CodecService, default_service
+from chubaofs_tpu.utils.breaker import CircuitBreaker
 from chubaofs_tpu.utils.exporter import default_registry
 
 MAX_BLOB_SIZE = 4 * 1024 * 1024
@@ -182,6 +183,11 @@ class Access:
         self._disk_sems: dict[int, threading.Semaphore] = {}
         self._punished: dict[int, float] = {}
         self._punish_lock = threading.Lock()
+        # client-side breaker around control-plane (allocator/proxy) calls:
+        # a dead allocator fails PUTs fast instead of stacking every request
+        # behind its timeouts (stream_put.go:68 hystrix analog)
+        self._alloc_breaker = CircuitBreaker("proxy-alloc", failures=5,
+                                             window=10.0, cooldown=5.0)
         self._pool = ThreadPoolExecutor(max_workers=max_workers, thread_name_prefix="access")
         # reads NEVER share the write pool: stripe writes can legitimately
         # hold slots up to write_deadline (wedged-disk containment), and a GET
@@ -255,7 +261,7 @@ class Access:
         loc = Location(cluster_id=self.cluster_id, code_mode=mode, size=len(data), crc=zlib.crc32(data))
 
         blobs = [data[i : i + MAX_BLOB_SIZE] for i in range(0, len(data), MAX_BLOB_SIZE)]
-        first_bid, _ = self.proxy.alloc_bids(len(blobs))
+        first_bid, _ = self._alloc_breaker.call(self.proxy.alloc_bids, len(blobs))
 
         # encode all blobs first (they batch inside the codec service), then
         # fan shard writes out per blob
@@ -263,7 +269,7 @@ class Access:
         metas = []
         t = get_tactic(mode)
         for i, blob in enumerate(blobs):
-            vol = self.proxy.alloc_volume(mode)
+            vol = self._alloc_breaker.call(self.proxy.alloc_volume, mode)
             shard_len = t.shard_size(len(blob))
             mat = np.zeros((t.N, shard_len), np.uint8)
             flat = mat.reshape(-1)
@@ -280,7 +286,7 @@ class Access:
                 # rotate: retire the full volume, take a fresh one, retry once
                 self.cm.set_volume_status(vol.vid, "idle")
                 self.proxy.invalidate(mode)
-                vol = self.proxy.alloc_volume(mode)
+                vol = self._alloc_breaker.call(self.proxy.alloc_volume, mode)
                 self._write_stripe(t, vol, bid, stripe)
             loc.blobs.append(Blob(bid=bid, vid=vol.vid, size=size))
 

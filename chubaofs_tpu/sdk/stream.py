@@ -311,15 +311,22 @@ class Streamer:
 
     def _overwrite(self, offset: int, data: bytes) -> None:
         """Flush dirty appends, then raft random-writes into owning extents
-        (the flush-before-overwrite rule, stream_writer.go:299-309)."""
+        (the flush-before-overwrite rule, stream_writer.go:299-309).
+
+        Ranges below the committed size that NO extent covers are holes a
+        truncate-up left behind: they get fresh extents of their own (keys
+        never overlap, so a hole-fill key at its file_offset slots straight
+        into the read paste) — silently skipping them would drop the bytes."""
         self.flush()
         inode = self.meta.get_inode(self.ino)
         end = offset + len(data)
+        covered: list[tuple[int, int]] = []
         for key in inode.extents:
             lo = max(offset, key.file_offset)
             hi = min(end, key.file_offset + key.size)
             if lo >= hi:
                 continue
+            covered.append((lo, hi))
             dp = self._dp_of(key.partition_id)
             pkt = Packet(
                 OP_RANDOM_WRITE, partition_id=key.partition_id,
@@ -330,6 +337,27 @@ class Streamer:
             rep = self.client.request(dp, pkt)
             if rep.result != RES_OK:
                 raise StreamError(f"random write: {rep.error()}")
+        # fill the uncovered holes with fresh extents
+        covered.sort()
+        pos = offset
+        holes: list[tuple[int, int]] = []
+        for lo, hi in covered:
+            if pos < lo:
+                holes.append((pos, lo))
+            pos = max(pos, hi)
+        if pos < end:
+            holes.append((pos, end))
+        for lo, hi in holes:
+            h = ExtentHandler(self.client, self.client.select(), lo)
+            try:
+                h.write(data[lo - offset: hi - offset])
+                keys = h.flush()
+            finally:
+                h.close()
+            if keys:
+                self.meta.append_extents(
+                    self.ino, keys, max(inode.size, keys[-1]["file_offset"]
+                                        + keys[-1]["size"]))
 
     def _append(self, offset: int, data: bytes) -> None:
         if offset > self.size:

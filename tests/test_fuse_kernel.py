@@ -219,6 +219,83 @@ def test_client_role_daemon_mounts_proccluster_volume(tmp_path):
         c.close()
 
 
+def test_fsx_style_random_soak_subprocess(mnt):
+    """fsx-analog (the LTP suite's adversarial cousin): a SEPARATE
+    interpreter runs seeded random op sequences — pwrite at random
+    offsets, truncate up/down, reopen, rename, hardlink, unlink —
+    against the kernel mount while mirroring every op on an in-memory
+    shadow; any divergence (content or size) fails. No chubaofs imports
+    in the accessing process."""
+    script = r"""
+import os, random, sys
+mnt, seed = sys.argv[1], int(sys.argv[2])
+rnd = random.Random(seed)
+path = os.path.join(mnt, f"fsx_{seed}.dat")
+shadow = bytearray()
+fd = os.open(path, os.O_CREAT | os.O_RDWR)
+MAXLEN = 300_000
+for step in range(120):
+    op = rnd.choice(["write", "write", "write", "read", "truncate",
+                     "reopen", "rename", "link_cycle"])
+    if op == "write":
+        off = rnd.randrange(0, max(1, len(shadow) + 1))
+        n = rnd.randrange(1, 40_000)
+        if off + n > MAXLEN:
+            n = max(1, MAXLEN - off)
+        blob = bytes(rnd.getrandbits(8) for _ in range(min(n, 4096))) * (n // min(n, 4096) + 1)
+        blob = blob[:n]
+        os.pwrite(fd, blob, off)
+        if off > len(shadow):
+            shadow.extend(b"\0" * (off - len(shadow)))
+        shadow[off:off + n] = blob
+    elif op == "read":
+        if shadow:
+            off = rnd.randrange(0, len(shadow))
+            n = rnd.randrange(1, len(shadow) - off + 1)
+            got = os.pread(fd, n, off)
+            want = bytes(shadow[off:off + n])
+            assert got == want, f"step {step}: read mismatch at {off}+{n}"
+    elif op == "truncate":
+        n = rnd.randrange(0, MAXLEN)
+        os.ftruncate(fd, n)
+        if n <= len(shadow):
+            del shadow[n:]
+        else:
+            shadow.extend(b"\0" * (n - len(shadow)))
+    elif op == "reopen":
+        os.close(fd)
+        fd = os.open(path, os.O_RDWR)
+    elif op == "rename":
+        os.close(fd)
+        a = os.path.join(mnt, f"fsx_{seed}.dat")
+        b = os.path.join(mnt, f"fsx_{seed}_r.dat")
+        new = b if path == a else a  # alternate, never a self-rename
+        os.rename(path, new)
+        path = new
+        fd = os.open(path, os.O_RDWR)
+    elif op == "link_cycle":
+        lnk = path + ".lnk"
+        os.link(path, lnk)
+        assert os.stat(lnk).st_size == os.stat(path).st_size
+        os.unlink(lnk)
+    # invariant every step: size agrees with the shadow
+    assert os.fstat(fd).st_size == len(shadow), f"step {step}: size drift"
+# final full-content check through a FRESH descriptor
+os.close(fd)
+with open(path, "rb") as f:
+    assert f.read() == bytes(shadow), "final content mismatch"
+os.unlink(path)
+print("FSX-OK")
+"""
+    import sys
+    for seed in (11, 12):
+        r = subprocess.run([sys.executable, "-c", script, mnt, str(seed)],
+                           capture_output=True, text=True, timeout=300,
+                           env={"PATH": os.environ.get("PATH", "")})
+        assert r.returncode == 0, f"seed {seed}: {r.stderr[-2000:]}"
+        assert "FSX-OK" in r.stdout
+
+
 def test_posix_battery_subprocess(mnt):
     """A python-driven mini-LTP in a SEPARATE interpreter (no repo imports):
     sequences of syscalls an fs test suite leans on."""

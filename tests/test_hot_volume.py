@@ -146,3 +146,23 @@ def test_hot_cluster_restart_reconnects(tmp_path_factory):
     new_hosts = {v["pid"]: v["hosts"] for v in views}
     assert set(new_hosts) == set(old_hosts)
     c2.close()
+
+
+def test_write_into_truncate_up_hole_not_dropped(cluster):
+    """Regression (found by the kernel-mount fsx soak): bytes written into
+    a hole a truncate-up created BELOW the committed size must get their
+    own extents — the overwrite path used to intersect only existing
+    extents and silently dropped them."""
+    fs = cluster.client("hotvol")
+    fs.write_file("/hole.bin", b"A" * 1000)
+    ino = fs.resolve("/hole.bin")
+    fs.meta.truncate(ino, 200_000)  # extend: [1000, 200000) is a hole
+    assert fs.read_at(ino, 150_000, 10) == b"\0" * 10
+    fs.write_at(ino, 100_000, b"B" * 5000)  # entirely inside the hole
+    assert fs.read_at(ino, 100_000, 5000) == b"B" * 5000
+    assert fs.read_at(ino, 99_990, 10) == b"\0" * 10  # hole around it intact
+    assert fs.read_at(ino, 0, 1000) == b"A" * 1000
+    # straddling write: part over an extent, part over the hole
+    fs.write_at(ino, 500, b"C" * 2000)
+    assert fs.read_at(ino, 500, 2000) == b"C" * 2000
+    assert fs.meta.get_inode(ino).size == 200_000
