@@ -345,16 +345,10 @@ class FuseServer:
         return self._attr_out(self._inode(nodeid))
 
     def _create_child(self, parent: int, name: str, mode: int):
-        """create_inode + create_dentry with the FsClient undo contract."""
+        """Delegates to the ONE create implementation (combined commit or
+        two-op fallback with undo, FsClient._create_node)."""
         qids = self.fs._parent_quota_ids(parent)
-        inode = self.meta.create_inode(mode, quota_ids=qids)
-        try:
-            self.meta.create_dentry(parent, name, inode.ino, inode.mode,
-                                    quota_ids=qids)
-        except OpError as e:
-            self.fs._undo_create(inode.ino)
-            raise FsError(e.code, name) from None
-        return inode
+        return self.fs._create_node(parent, name, mode, qids, name)
 
     def _do_mknod(self, nodeid, body, uid, gid) -> bytes:
         mode, rdev, _umask, _pad = MKNOD_IN.unpack_from(body)

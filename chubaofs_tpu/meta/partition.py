@@ -290,6 +290,38 @@ class MetaPartitionSM(StateMachine):
         self.inodes[ino] = inode
         return inode
 
+    def _op_create_inode_dentry(self, parent: int, name: str, mode: int,
+                                uid: int = 0, gid: int = 0,
+                                quota_ids: list[int] | None = None):
+        """Combined create: inode + dentry in ONE raft commit when the
+        parent lives in this partition (the common single-tail-MP case).
+        Halves the per-create consensus round-trips vs the two-op flow
+        (create_inode then create_dentry) while keeping its invariants:
+        every check — name conflict, parent type, locks, file-count quota —
+        runs BEFORE the inode allocates, so a failed create leaves nothing
+        behind to undo and burns no inode-range slot."""
+        key = (parent, name)
+        self._check_lock(("d", parent, name), None)
+        self._check_lock(("c", parent), None)
+        if key in self.dentries:
+            raise Exists(f"{name!r} exists in {parent}")
+        pdir = self._get_inode(parent)
+        if not pdir.is_dir:
+            raise NotDir(f"parent {parent}")
+        # quota charge is ALSO a pre-check (it raises EDQUOT before any
+        # mutation): an EDQUOT-looping client never burns inode-range
+        # slots on a full quota
+        self._quota_charge_files(quota_ids, +1)
+        try:
+            inode = self._op_create_inode(mode, uid, gid, quota_ids)
+        except MetaError:  # OutOfRange: refund the charge, nothing mutated
+            self._quota_charge_files(quota_ids, -1)
+            raise
+        # _committing=True: locks checked and quota charged above
+        self._op_create_dentry(parent, name, inode.ino, inode.mode,
+                               quota_ids=quota_ids, _committing=True)
+        return inode
+
     def _inode_quota_ids(self, inode: Inode) -> list[int]:
         raw = inode.xattrs.get(self.QUOTA_XATTR)
         if not raw:

@@ -153,17 +153,33 @@ class FsClient:
         except OpError:
             pass  # freelist sweeps catch stragglers
 
-    def mkdir(self, path: str, mode: int = 0o755) -> int:
-        parent, name = self._resolve_parent(path)
-        qids = self._parent_quota_ids(parent)
-        inode = self.meta.create_inode(stat_mod.S_IFDIR | mode, quota_ids=qids)
+    def _create_node(self, parent: int, name: str, mode: int,
+                     qids: list[int], path: str):
+        """Create inode+dentry under parent, returning the Inode: ONE
+        combined commit when the tail partition owns the parent
+        (MetaWrapper.create_file), else the two-op flow with the
+        undo-on-conflict contract. The ONE create implementation — the
+        FUSE server delegates here too."""
+        try:
+            inode = self.meta.create_file(parent, name, mode, quota_ids=qids)
+        except OpError as e:
+            raise FsError(e.code, path) from None
+        if inode is not None:
+            return inode
+        inode = self.meta.create_inode(mode, quota_ids=qids)
         try:
             self.meta.create_dentry(parent, name, inode.ino, inode.mode,
                                     quota_ids=qids)
         except OpError as e:
             self._undo_create(inode.ino)
             raise FsError(e.code, path) from None
-        return inode.ino
+        return inode
+
+    def mkdir(self, path: str, mode: int = 0o755) -> int:
+        parent, name = self._resolve_parent(path)
+        qids = self._parent_quota_ids(parent)
+        return self._create_node(parent, name, stat_mod.S_IFDIR | mode,
+                                 qids, path).ino
 
     def mkdirs(self, path: str, mode: int = 0o755) -> int:
         """mkdir -p (libsdk cfs_mkdirs analog); returns the leaf inode."""
@@ -212,14 +228,8 @@ class FsClient:
     def create(self, path: str, mode: int = 0o644) -> int:
         parent, name = self._resolve_parent(path)
         qids = self._parent_quota_ids(parent)
-        inode = self.meta.create_inode(stat_mod.S_IFREG | mode, quota_ids=qids)
-        try:
-            self.meta.create_dentry(parent, name, inode.ino, inode.mode,
-                                    quota_ids=qids)
-        except OpError as e:
-            self._undo_create(inode.ino)
-            raise FsError(e.code, path) from None
-        return inode.ino
+        return self._create_node(parent, name, stat_mod.S_IFREG | mode,
+                                 qids, path).ino
 
     def write_file(self, path: str, data: bytes) -> int:
         """Whole-file write (create-or-truncate), the common S3/batch shape."""

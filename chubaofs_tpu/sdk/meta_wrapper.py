@@ -116,6 +116,23 @@ class MetaWrapper:
         return self.submit(mp, "create_inode", mode=mode, uid=uid, gid=gid,
                            quota_ids=quota_ids or [])
 
+    def create_file(self, parent: int, name: str, mode: int,
+                    quota_ids: list[int] | None = None):
+        """Inode + dentry in one commit when the parent's partition is also
+        the inode-allocating (tail) partition — the common case, since the
+        tail holds every recently-created directory. Falls back to the
+        two-op flow (with its undo-on-conflict contract handled by the
+        CALLER, as FsClient does) across partitions. Returns the inode."""
+        # ONE view fetch (a master RPC in remote mode) decides both roles —
+        # two fetches could disagree across a concurrent tail split
+        mps = self._view().meta_partitions
+        tail = mps[-1]
+        if tail.start <= parent < tail.end:
+            return self.submit(tail, "create_inode_dentry", parent=parent,
+                               name=name, mode=mode,
+                               quota_ids=quota_ids or [])
+        return None  # caller takes the two-op path
+
     def create_dentry(self, parent: int, name: str, ino: int, mode: int,
                       quota_ids: list[int] | None = None):
         mp = self.partition_of(parent)
