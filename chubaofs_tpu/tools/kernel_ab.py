@@ -1,0 +1,109 @@
+"""A/B harness: fused (auto-pipelined) vs manual-DMA double-buffered kernel.
+
+Runs the bench methodology (slope timing, median-of-passes, HBM floor) over
+the BASELINE configs for BOTH kernel lowerings and prints one JSON line per
+(config, kernel) plus a final verdict line. Used to decide whether
+CFS_GF_PIPELINED should become the default (PERF.md headroom #1) — the
+answer is chip-empirical, so the tool exists instead of a guess.
+
+    python -m chubaofs_tpu.tools.kernel_ab [--tile-sweep]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+import numpy as np
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="cfs-kernel-ab")
+    p.add_argument("--tile-sweep", action="store_true",
+                   help="also sweep pipelined tile sizes on EC(12,4)")
+    p.add_argument("--batch", type=int, default=16)
+    args = p.parse_args(argv)
+
+    # bench.py's watchdog probe, then its timing machinery
+    sys.path.insert(0, "/root/repo")
+    from bench import _resolve_device, hbm_floor, stage_grouped, throughput
+
+    import jax
+
+    from chubaofs_tpu.ops import pallas_gf_pipe, rs
+
+    dev = _resolve_device()
+    log(f"device={dev}")
+    rng = np.random.default_rng(0)
+    MiB = 1 << 20
+
+    configs = [
+        ("ec4p2_1mib", 4, 2, 1 * MiB, 64),
+        ("ec6p3_4mib", 6, 3, 4 * MiB, 24),
+        ("ec12p4_8mib", 12, 4, 8 * MiB, args.batch),
+    ]
+    results: dict[str, dict[str, float]] = {}
+    for name, n, m, stripe, batch in configs:
+        k = -(-stripe // n // 128) * 128
+        kernel = rs.get_kernel(n, m)
+        host = rng.integers(0, 256, (batch, n, k), dtype=np.uint8)
+        mat_s, data = stage_grouped(dev, host, kernel.parity_bits)
+        floor = hbm_floor(batch * (n + m) * k, dev)
+        res: dict[str, float] = {}
+
+        from chubaofs_tpu.ops import pallas_gf
+
+        per = throughput(
+            jax.jit(lambda s: pallas_gf.gf_matmul_bytes_fused(mat_s, s)),
+            (data,), floor=floor)
+        res["fused_gbps"] = round(batch * n * k / per / 1e9, 2)
+        log(f"{name}: fused {res['fused_gbps']} GB/s")
+
+        try:
+            per = throughput(
+                jax.jit(lambda s: pallas_gf_pipe.gf_matmul_bytes_pipelined(
+                    mat_s, s)), (data,), floor=floor)
+            res["pipelined_gbps"] = round(batch * n * k / per / 1e9, 2)
+            log(f"{name}: pipelined {res['pipelined_gbps']} GB/s")
+        except Exception as e:  # Mosaic rejection is a RESULT, not a crash
+            res["pipelined_error"] = str(e)[-400:]
+            log(f"{name}: pipelined FAILED: {str(e)[-400:]}")
+        results[name] = res
+        print(json.dumps({"config": name, **res}), flush=True)
+
+    if args.tile_sweep and "pipelined_gbps" in results.get("ec12p4_8mib", {}):
+        name, n, m, stripe, batch = configs[-1]
+        k = -(-stripe // n // 128) * 128
+        kernel = rs.get_kernel(n, m)
+        host = rng.integers(0, 256, (batch, n, k), dtype=np.uint8)
+        mat_s, data = stage_grouped(dev, host, kernel.parity_bits)
+        floor = hbm_floor(batch * (n + m) * k, dev)
+        for kt in (2048, 4096, 7424, 14848, 29696):
+            try:
+                per = throughput(
+                    jax.jit(lambda s, kt=kt:
+                            pallas_gf_pipe.gf_matmul_bytes_pipelined(
+                                mat_s, s, tile_k=kt)), (data,), floor=floor)
+                gbps = round(batch * n * k / per / 1e9, 2)
+            except Exception as e:
+                gbps = f"ERR {str(e)[-120:]}"
+            print(json.dumps({"config": "ec12p4_tile_sweep", "tile_k": kt,
+                              "gbps": gbps}), flush=True)
+
+    winner = {
+        name: ("pipelined" if r.get("pipelined_gbps", 0) > r["fused_gbps"]
+               else "fused")
+        for name, r in results.items()
+    }
+    print(json.dumps({"verdict": winner}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
