@@ -366,35 +366,20 @@ class FuseServer:
 
     def _do_unlink(self, nodeid, body, uid, gid) -> None:
         name = self._name(body)
-        try:
-            d = self.meta.lookup(nodeid, name)
-            if stat_mod.S_ISDIR(d.mode):
-                raise FsError("EISDIR", name)
-            self.meta.delete_dentry(nodeid, name,
-                                    quota_ids=self.fs._parent_quota_ids(nodeid))
-        except OpError as e:
-            raise FsError(e.code, name) from None
-        self.meta.unlink_inode(d.ino)
-        if self._inode(d.ino).nlink <= 0:
+        ino, nlink = self.fs._remove_node(nodeid, name, want_dir=False,
+                                          path=name)
+        if nlink <= 0:
             with self._lock:
-                still_open = self._open_count.get(d.ino, 0) > 0
+                still_open = self._open_count.get(ino, 0) > 0
                 if still_open:
-                    self._orphans.add(d.ino)
+                    self._orphans.add(ino)
             if not still_open:
-                self.fs.evict_ino(d.ino)
+                self.fs.evict_ino(ino)
 
     def _do_rmdir(self, nodeid, body, uid, gid) -> None:
         name = self._name(body)
-        try:
-            d = self.meta.lookup(nodeid, name)
-            if not stat_mod.S_ISDIR(d.mode):
-                raise FsError("ENOTDIR", name)
-            self.meta.delete_dentry(nodeid, name,
-                                    quota_ids=self.fs._parent_quota_ids(nodeid))
-        except OpError as e:
-            raise FsError(e.code, name) from None
-        self.meta.unlink_inode(d.ino)
-        self.meta.evict_inode(d.ino)
+        ino, _ = self.fs._remove_node(nodeid, name, want_dir=True, path=name)
+        self.meta.evict_inode(ino)
 
     def _rename(self, nodeid: int, newdir: int, rest: bytes) -> None:
         src, dst = rest.split(b"\0")[:2]

@@ -46,6 +46,18 @@ class NotDir(MetaError):
     code = "ENOTDIR"
 
 
+class IsDir(MetaError):
+    code = "EISDIR"
+
+
+class CrossPartition(MetaError):
+    """Combined op aborted: the child inode lives in another partition.
+    A pure pre-check failure (nothing mutated) — the client falls back to
+    the per-op flow."""
+
+    code = "EXDEVPART"
+
+
 class TxConflict(MetaError):
     code = "ETXCONFLICT"
 
@@ -289,6 +301,27 @@ class MetaPartitionSM(StateMachine):
             inode.xattrs[self.QUOTA_XATTR] = _json.dumps(quota_ids).encode()
         self.inodes[ino] = inode
         return inode
+
+    def _op_delete_dentry_unlink(self, parent: int, name: str,
+                                 quota_ids: list[int] | None = None,
+                                 want_dir: bool | None = None):
+        """Combined remove: lookup + delete_dentry + unlink_inode in ONE
+        raft commit when this partition owns BOTH the parent and the
+        child's inode (the single-tail-MP common case) — the client also
+        saves its pre-lookup round-trip. `want_dir` enforces the caller's
+        rmdir/unlink type expectation inside the commit (no TOCTOU against
+        a concurrent rename-over). Raises CrossPartition when the child
+        inode lives elsewhere; the client falls back to the per-op flow."""
+        d = self.dentries.get((parent, name))
+        if d is None:
+            raise NoEntry(f"{name!r} in {parent}")
+        if want_dir is not None and stat_mod.S_ISDIR(d.mode) != want_dir:
+            raise (NotDir if want_dir else IsDir)(f"{name!r}")
+        if not self.owns_ino(d.ino):
+            raise CrossPartition(f"ino {d.ino} outside [{self.start},{self.end})")
+        self._op_delete_dentry(parent, name, quota_ids=quota_ids)
+        inode = self._op_unlink_inode(d.ino)
+        return d.ino, inode.nlink
 
     def _op_create_inode_dentry(self, parent: int, name: str, mode: int,
                                 uid: int = 0, gid: int = 0,

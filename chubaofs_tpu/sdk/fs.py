@@ -210,18 +210,32 @@ class FsClient:
         except OpError as e:
             raise FsError(e.code, path) from None
 
-    def rmdir(self, path: str) -> None:
-        parent, name = self._resolve_parent(path)
+    def _remove_node(self, parent: int, name: str, want_dir: bool,
+                     path: str) -> tuple[int, int]:
+        """Remove dentry + drop a link, returning (ino, nlink_after): ONE
+        combined commit when one partition owns parent and child (also
+        saving the pre-lookup round-trip), else the lookup + per-op flow.
+        The ONE remove implementation — the FUSE server delegates here."""
+        qids = self._parent_quota_ids(parent)
         try:
+            res = self.meta.remove_entry(parent, name, want_dir,
+                                         quota_ids=qids)
+            if res is not None:
+                return res
+            # cross-partition child: classic flow
             d = self.meta.lookup(parent, name)
-            if not stat_mod.S_ISDIR(d.mode):
-                raise FsError("ENOTDIR", path)
-            self.meta.delete_dentry(parent, name,
-                                    quota_ids=self._parent_quota_ids(parent))
+            if stat_mod.S_ISDIR(d.mode) != want_dir:
+                raise FsError("ENOTDIR" if want_dir else "EISDIR", path)
+            self.meta.delete_dentry(parent, name, quota_ids=qids)
+            inode = self.meta.unlink_inode(d.ino)
         except OpError as e:
             raise FsError(e.code, path) from None
-        self.meta.unlink_inode(d.ino)
-        self.meta.evict_inode(d.ino)
+        return d.ino, inode.nlink
+
+    def rmdir(self, path: str) -> None:
+        parent, name = self._resolve_parent(path)
+        ino, _ = self._remove_node(parent, name, want_dir=True, path=path)
+        self.meta.evict_inode(ino)
 
     # -- file verbs --------------------------------------------------------------
 
@@ -322,18 +336,10 @@ class FsClient:
         for holders of open handles (client orphan list); the caller must
         evict_ino() on last close. Returns the inode id."""
         parent, name = self._resolve_parent(path)
-        try:
-            d = self.meta.lookup(parent, name)
-            if stat_mod.S_ISDIR(d.mode):
-                raise FsError("EISDIR", path)
-            self.meta.delete_dentry(parent, name,
-                                    quota_ids=self._parent_quota_ids(parent))
-        except OpError as e:
-            raise FsError(e.code, path) from None
-        self.meta.unlink_inode(d.ino)
+        ino, _ = self._remove_node(parent, name, want_dir=False, path=path)
         if evict:
-            self.meta.evict_inode(d.ino)
-        return d.ino
+            self.meta.evict_inode(ino)
+        return ino
 
     def evict_ino(self, ino: int) -> None:
         """Release an orphaned inode once its last open handle closes."""

@@ -151,6 +151,23 @@ class MetaWrapper:
         mp = self.partition_of(parent)
         return self._on_partition(mp, lambda n: n.read_dir(mp.partition_id, parent))
 
+    def remove_entry(self, parent: int, name: str, want_dir: bool,
+                     quota_ids: list[int] | None = None):
+        """Combined lookup + delete_dentry + unlink_inode in one commit
+        when the parent's partition also owns the child inode; returns
+        (ino, nlink_after) or None when the child lives in another
+        partition (caller falls back to the per-op flow)."""
+        mp = self.partition_of(parent)
+        try:
+            res = self.submit(mp, "delete_dentry_unlink", parent=parent,
+                              name=name, want_dir=want_dir,
+                              quota_ids=quota_ids or [])
+        except OpError as e:
+            if e.code == "EXDEVPART":
+                return None
+            raise
+        return res[0], res[1]
+
     def delete_dentry(self, parent: int, name: str,
                       quota_ids: list[int] | None = None):
         mp = self.partition_of(parent)
