@@ -61,6 +61,7 @@ class MasterAPI:
     def _build(self) -> Router:
         r = Router()
         g = r.get
+        g("/metrics", self.metrics)  # raw text/plain, no JSON envelope
         g("/admin/getCluster", self._w(self.get_cluster, leader=False))
         g("/admin/getClusterStat", self._w(self.get_cluster_stat, leader=False))
         g("/admin/getTopology", self._w(self.get_topology, leader=False))
@@ -153,6 +154,36 @@ class MasterAPI:
 
     def get_ip(self, req: Request):
         return {"cluster": "chubaofs-tpu", "ip": req.remote}
+
+    def metrics(self, req: Request) -> Response:
+        """Prometheus exposition of the cluster rollups — the
+        master/monitor_metrics.go analog, derived on scrape from the same
+        replicated state the stat endpoints read (no ticker staleness).
+        Served by every master (leader=False scrape-ability)."""
+        from chubaofs_tpu.utils.exporter import Registry
+
+        reg = Registry(cluster="", module="master")  # namespace cfs_master
+        st = self.master.cluster_stat()
+        for kind in ("data", "meta"):
+            reg.gauge("total_space_bytes", {"kind": kind}).set(
+                st[kind]["total_space"])
+            reg.gauge("used_space_bytes", {"kind": kind}).set(
+                st[kind]["used_space"])
+            reg.gauge("nodes", {"kind": kind}).set(st[kind]["nodes"])
+            reg.gauge("nodes_active", {"kind": kind}).set(st[kind]["active"])
+        reg.gauge("volumes").set(st["volumes"])
+        reg.gauge("meta_partitions").set(st["meta_partitions"])
+        reg.gauge("data_partitions").set(st["data_partitions"])
+        reg.gauge("is_leader").set(1 if self.master.is_leader else 0)
+        for vol in self.master.sm.volumes.values():
+            lv = {"volume": vol.name}
+            reg.gauge("vol_capacity_bytes", lv).set(vol.capacity)
+            reg.gauge("vol_meta_partitions", lv).set(len(vol.meta_partitions))
+            reg.gauge("vol_data_partitions", lv).set(len(vol.data_partitions))
+            reg.gauge("vol_dp_rw", lv).set(
+                sum(1 for dp in vol.data_partitions if dp.status == "rw"))
+        return Response(200, {"Content-Type": "text/plain; version=0.0.4"},
+                        reg.render().encode())
 
     def get_zone_domains(self, req: Request):
         """zone -> fault domain map (master/topology.go:43 domain mode)."""

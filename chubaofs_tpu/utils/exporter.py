@@ -125,12 +125,20 @@ class Registry:
 
     def render(self) -> str:
         """Prometheus text exposition of every metric in the registry."""
+
+        def esc(v) -> str:
+            # label-value escaping per the text format: one hostile value
+            # (e.g. a volume named 'a"b') must not invalidate the whole
+            # scrape for every other metric
+            return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+                    .replace("\n", "\\n"))
+
         lines = []
         with self._lock:
             items = sorted(self._metrics.items())
         for (name, labels), m in items:
             full = f"{self.namespace}_{name}"
-            lab = ("{" + ",".join(f'{k}="{v}"' for k, v in labels) + "}") if labels else ""
+            lab = ("{" + ",".join(f'{k}="{esc(v)}"' for k, v in labels) + "}") if labels else ""
             if isinstance(m, Counter):
                 lines.append(f"{full}{lab} {m.value}")
             elif isinstance(m, Gauge):

@@ -315,3 +315,48 @@ def test_cli_against_daemon_cluster(cluster, capsys):
 
     out = run("completion")
     assert "complete -F _cfs_cli" in out
+
+
+def test_master_metrics_endpoint(tmp_path):
+    """Prometheus rollups on /metrics (monitor_metrics.go analog): plain
+    text, per-kind space gauges, per-volume partition gauges, scrapeable
+    from any master (not just the leader)."""
+    import http.client
+
+    from chubaofs_tpu.testing.harness import ProcCluster
+
+    def scrape(addr):
+        host, port = addr.rsplit(":", 1)
+        conn = http.client.HTTPConnection(host, int(port), timeout=10)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        assert resp.status == 200
+        assert resp.getheader("Content-Type", "").startswith("text/plain")
+        conn.close()
+        return body
+
+    import time
+
+    c = ProcCluster(str(tmp_path), masters=3, metanodes=3, datanodes=3)
+    try:
+        c.client_master().create_volume("mv", cold=False)
+        # followers serve their REPLICA's state: poll briefly for the raft
+        # log to converge before asserting exact counts
+        deadline = time.time() + 30
+        while True:
+            bodies = [scrape(a) for a in c.master_addrs]
+            if all('cfs_master_vol_data_partitions{volume="mv"} 3' in b
+                   for b in bodies) or time.time() > deadline:
+                break
+            time.sleep(0.5)
+        for body in bodies:
+            assert 'cfs_master_nodes{kind="data"} 3' in body
+            assert 'cfs_master_vol_data_partitions{volume="mv"} 3' in body
+        # exactly one leader; FOLLOWERS answer the scrape too (the route
+        # skips the leader gate) and say so
+        leaders = sum("cfs_master_is_leader 1" in b for b in bodies)
+        followers = sum("cfs_master_is_leader 0" in b for b in bodies)
+        assert (leaders, followers) == (1, 2), (leaders, followers)
+    finally:
+        c.close()
