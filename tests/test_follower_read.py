@@ -93,6 +93,49 @@ def test_read_hosts_ranking_prefers_fast_replicas():
     assert ec2.read_hosts(dp) == dp["hosts"]
 
 
+def test_reads_survive_continuous_leader_churn(cluster):
+    """Soak (compact form of the round-5 churn hunt, 109k reads clean):
+    data-partition leaders get demoted continuously while a client reads —
+    follower-read keeps every read correct with no election needed."""
+    import random
+    import threading
+    import time
+
+    from chubaofs_tpu.deploy import DATANODE_ID_BASE
+
+    fs = cluster.client("frvol")
+    payload = bytes(range(256)) * 400
+    fs.write_file("/churn.bin", payload)
+
+    stop = threading.Event()
+
+    def churn():
+        rnd = random.Random(7)
+        while not stop.is_set():
+            for nid, raft in cluster.rafts.items():
+                if nid < DATANODE_ID_BASE:
+                    continue
+                for g in list(raft.groups.values()):
+                    if g.core.role == "leader" and rnd.random() < 0.5:
+                        g.core.role = ROLE_FOLLOWER
+                        g.core.leader = None
+            time.sleep(0.02)
+
+    t = threading.Thread(target=churn, daemon=True)
+    t.start()
+    reader = cluster.client("frvol")
+    try:
+        deadline = time.time() + 5
+        n = 0
+        while time.time() < deadline:
+            assert reader.read_file("/churn.bin") == payload
+            n += 1
+        assert n > 20, n
+    finally:
+        stop.set()
+        t.join()
+
+
 def test_follower_read_packets_flagged(cluster):
     """The wire carries the relaxed-consistency opt-in, so followers serve
     without a leadership check only when the volume asked for it."""
