@@ -56,72 +56,119 @@ def _pick_tile(n: int, r: int, k: int) -> int:
     return max(128, min(kt, k, 65536))
 
 
+def _stage_helpers(n: int, r: int, kt: int, mat_ref, data_hbm, out_hbm,
+                   data_buf, bits_buf, out_buf, in_sems, out_sems):
+    """(in_dma, out_dma, unpack, compute) shared by both slot strategies —
+    only the loop-body SCHEDULING differs between the kernel factories."""
+    i = pl.program_id(0)
+
+    def in_dma(slot, t):
+        return pltpu.make_async_copy(
+            data_hbm.at[i].at[:, pl.ds(t * kt, kt)],
+            data_buf.at[slot], in_sems.at[slot])
+
+    def out_dma(slot, t):
+        return pltpu.make_async_copy(
+            out_buf.at[slot],
+            out_hbm.at[i].at[:, pl.ds(t * kt, kt)], out_sems.at[slot])
+
+    def unpack(slot):
+        d32 = data_buf[slot].astype(jnp.int32)
+        planes = [((d32 >> bb) & 1).astype(jnp.int8) for bb in range(BITS)]
+        bits_buf[slot] = jnp.concatenate(planes, axis=0)
+
+    def compute(slot):
+        acc = jax.lax.dot_general(
+            mat_ref[...], bits_buf[slot],
+            dimension_numbers=(((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32)
+        packed = acc[0:r] & 1
+        for bb in range(1, BITS):
+            packed |= (acc[bb * r:(bb + 1) * r] & 1) << bb
+        out_buf[slot] = packed.astype(jnp.uint8)
+
+    return in_dma, out_dma, unpack, compute
+
+
+def _skew_half(n_tiles, in_dma, out_dma, unpack, compute, t, slot, prev):
+    """One skewed-pipeline iteration t with its two buffer slots: load +
+    unpack tile t while computing + storing tile t-1."""
+
+    @pl.when(t < n_tiles)
+    def _load_unpack():
+        in_dma(slot, t).wait()
+
+        @pl.when(t + 1 < n_tiles)
+        def _():
+            in_dma(prev, t + 1).start()
+
+        unpack(slot)
+
+    @pl.when((t >= 1) & (t <= n_tiles))
+    def _compute_store():
+        tc = t - 1
+
+        @pl.when(tc >= 2)
+        def _():  # slot reuse: tile tc-2 used the same out slot
+            out_dma(prev, tc - 2).wait()
+
+        compute(prev)
+        out_dma(prev, tc).start()
+
+
+def _drain(out_dma, n_tiles):
+    out_dma((n_tiles - 1) % 2, n_tiles - 1).wait()
+    if n_tiles >= 2:
+        out_dma((n_tiles - 2) % 2, n_tiles - 2).wait()
+
+
 def _make_kernel(n: int, r: int, kt: int, n_tiles: int):
     """Kernel body for one stripe row: manual skewed double-buffer pipeline."""
 
     def kernel(mat_ref, data_hbm, out_hbm, data_buf, bits_buf, out_buf,
                in_sems, out_sems):
-        i = pl.program_id(0)
-
-        def in_dma(slot, t):
-            return pltpu.make_async_copy(
-                data_hbm.at[i].at[:, pl.ds(t * kt, kt)],
-                data_buf.at[slot], in_sems.at[slot])
-
-        def out_dma(slot, t):
-            return pltpu.make_async_copy(
-                out_buf.at[slot],
-                out_hbm.at[i].at[:, pl.ds(t * kt, kt)], out_sems.at[slot])
-
-        def unpack(slot):
-            d32 = data_buf[slot].astype(jnp.int32)
-            planes = [((d32 >> bb) & 1).astype(jnp.int8) for bb in range(BITS)]
-            bits_buf[slot] = jnp.concatenate(planes, axis=0)
-
-        def compute(slot):
-            acc = jax.lax.dot_general(
-                mat_ref[...], bits_buf[slot],
-                dimension_numbers=(((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.int32)
-            packed = acc[0:r] & 1
-            for bb in range(1, BITS):
-                packed |= (acc[bb * r:(bb + 1) * r] & 1) << bb
-            out_buf[slot] = packed.astype(jnp.uint8)
-
+        in_dma, out_dma, unpack, compute = _stage_helpers(
+            n, r, kt, mat_ref, data_hbm, out_hbm, data_buf, bits_buf,
+            out_buf, in_sems, out_sems)
         in_dma(0, 0).start()
 
         def body(t, _):
             slot = jax.lax.rem(t, 2)
             prev = jax.lax.rem(t + 1, 2)  # == (t-1) % 2
-
-            @pl.when(t < n_tiles)
-            def _load_unpack():
-                in_dma(slot, t).wait()
-
-                @pl.when(t + 1 < n_tiles)
-                def _():
-                    in_dma(prev, t + 1).start()
-
-                unpack(slot)
-
-            @pl.when(t >= 1)
-            def _compute_store():
-                tc = t - 1
-
-                @pl.when(tc >= 2)
-                def _():  # slot reuse: tile tc-2 used the same out slot
-                    out_dma(prev, tc - 2).wait()
-
-                compute(prev)
-                out_dma(prev, tc).start()
-
+            _skew_half(n_tiles, in_dma, out_dma, unpack, compute,
+                       t, slot, prev)
             return 0
 
         jax.lax.fori_loop(0, n_tiles + 1, body, 0)
-        # drain the last two out-DMAs (slots of tiles T-1 and T-2)
-        out_dma((n_tiles - 1) % 2, n_tiles - 1).wait()
-        if n_tiles >= 2:
-            out_dma((n_tiles - 2) % 2, n_tiles - 2).wait()
+        _drain(out_dma, n_tiles)
+
+    return kernel
+
+
+def _make_kernel_static(n: int, r: int, kt: int, n_tiles: int):
+    """Same skewed pipeline with STATIC buffer slots: the loop walks PAIRS
+    of tiles, each half hard-coding slot 0/1. Plan B for the case where
+    Mosaic rejects the dynamic `scratch.at[traced_slot]` indexing of
+    _make_kernel — identical semantics, verified against it in interpret
+    mode; kernel_ab falls back to it automatically on compile failure."""
+
+    def kernel(mat_ref, data_hbm, out_hbm, data_buf, bits_buf, out_buf,
+               in_sems, out_sems):
+        in_dma, out_dma, unpack, compute = _stage_helpers(
+            n, r, kt, mat_ref, data_hbm, out_hbm, data_buf, bits_buf,
+            out_buf, in_sems, out_sems)
+        in_dma(0, 0).start()
+
+        def body(tp, _):
+            t0 = 2 * tp
+            # even tile: load slot0, compute slot1; odd tile: the reverse
+            _skew_half(n_tiles, in_dma, out_dma, unpack, compute, t0, 0, 1)
+            _skew_half(n_tiles, in_dma, out_dma, unpack, compute,
+                       t0 + 1, 1, 0)
+            return 0
+
+        jax.lax.fori_loop(0, (n_tiles + 2) // 2, body, 0)
+        _drain(out_dma, n_tiles)
 
     return kernel
 
@@ -131,6 +178,7 @@ def gf_matmul_bytes_pipelined(
     shards: jax.Array,
     tile_k: int | None = None,
     interpret: bool = False,
+    static_slots: bool = False,
 ) -> jax.Array:
     """Drop-in equivalent of pallas_gf.gf_matmul_bytes_fused (same contract:
     byte-major (8r, 8n) matrix, (..., n, k) uint8 shards -> (..., r, k))."""
@@ -149,12 +197,13 @@ def gf_matmul_bytes_pipelined(
     else:
         mat_pm = mat_bits[jnp.asarray(_perm(r))][:, jnp.asarray(_perm(n))]
     out = _pipe_core(mat_pm, shards.reshape(b, n, k), tile_k=tile_k,
-                     interpret=interpret)
+                     interpret=interpret, static_slots=static_slots)
     return out.reshape(*lead, r, k)
 
 
-@functools.partial(jax.jit, static_argnames=("tile_k", "interpret"))
-def _pipe_core(mat_pm, data, tile_k, interpret):
+@functools.partial(jax.jit,
+                   static_argnames=("tile_k", "interpret", "static_slots"))
+def _pipe_core(mat_pm, data, tile_k, interpret, static_slots=False):
     b, n, k = data.shape
     r8, n8 = mat_pm.shape
     r = r8 // BITS
@@ -167,8 +216,9 @@ def _pipe_core(mat_pm, data, tile_k, interpret):
     if kp != k:
         data = jnp.pad(data, ((0, 0), (0, 0), (0, kp - k)))
 
+    make = _make_kernel_static if static_slots else _make_kernel
     out = pl.pallas_call(
-        _make_kernel(n, r, kt, n_tiles),
+        make(n, r, kt, n_tiles),
         grid=(b,),
         in_specs=[
             pl.BlockSpec((r8, n8), lambda i: (0, 0), memory_space=pltpu.VMEM),

@@ -78,12 +78,17 @@ def gf_matmul_dispatch(mat_bits: jax.Array, shards: jax.Array) -> jax.Array:
     if _use_fused():
         import os
 
-        if os.environ.get("CFS_GF_PIPELINED") == "1":
+        pipe = os.environ.get("CFS_GF_PIPELINED", "")
+        if pipe in ("1", "static"):
             # manual-DMA double-buffered variant (PERF.md headroom #1);
-            # opt-in until the bench proves it beats streaming fusion
+            # opt-in until the bench proves it beats streaming fusion.
+            # "static" selects the static-slot plan-B lowering for chips
+            # where Mosaic rejects dynamic scratch indexing (kernel_ab's
+            # verdict names the variant to use).
             from chubaofs_tpu.ops import pallas_gf_pipe
 
-            return pallas_gf_pipe.gf_matmul_bytes_pipelined(mat_bits, shards)
+            return pallas_gf_pipe.gf_matmul_bytes_pipelined(
+                mat_bits, shards, static_slots=pipe == "static")
         from chubaofs_tpu.ops import pallas_gf
 
         return pallas_gf.gf_matmul_bytes_fused(mat_bits, shards)

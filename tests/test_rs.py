@@ -133,16 +133,20 @@ def test_fused_pallas_kernel_interpret(rng):
 def test_pipelined_pallas_kernel_interpret(rng):
     """The manual-DMA double-buffered kernel (interpret mode) matches the XLA
     lowering — multi-tile (odd AND even tile counts, exercising both skew
-    phases and the epilogue drains) plus the single-tile degenerate case."""
+    phases and the epilogue drains) plus the single-tile degenerate case.
+    Both slot strategies (dynamic indexing and the static-unrolled plan-B
+    variant) must agree."""
     from chubaofs_tpu.ops import pallas_gf_pipe
 
     ker = rs.get_kernel(6, 3)
     for k in (128, 256, 384, 640):  # 1, 2, 3, 5 tiles at tile_k=128
         data = rng.integers(0, 256, (2, 6, k), dtype=np.uint8)
         want = np.asarray(rs.gf_matmul_bytes(ker.parity_bits, data))
-        got = np.asarray(pallas_gf_pipe.gf_matmul_bytes_pipelined(
-            ker.parity_bits, data, tile_k=128, interpret=True))
-        assert np.array_equal(got, want), k
+        for static in (False, True):
+            got = np.asarray(pallas_gf_pipe.gf_matmul_bytes_pipelined(
+                ker.parity_bits, data, tile_k=128, interpret=True,
+                static_slots=static))
+            assert np.array_equal(got, want), (k, static)
 
 
 def test_pipelined_kernel_group_stacked_interpret(rng):
