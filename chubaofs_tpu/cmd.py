@@ -361,6 +361,9 @@ class MasterDaemon(_Daemon):
         self.master.check_dead_node_replicas(dead_after=self.dead_node_secs)
         # under-replicated partitions (partial migrations) gain replacements
         self.master.ensure_replica_counts()
+        # domain-concentrated partitions (multi-domain-outage residue)
+        # re-spread once a free healthy domain exists
+        self.master.check_replica_spread()
         # long-silent drained nodes leave the registry
         self.master.prune_stale_nodes(stale_after=60 * self.dead_node_secs)
         # partitions a node reports but no volume records: failed deletes/

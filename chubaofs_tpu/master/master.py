@@ -841,39 +841,86 @@ class Master:
         with self._decomm_lock:
             return self._migrate_datanode(node_id)
 
+    def _move_dp_replica(self, vol, dp, node_id: int,
+                         prefer_zone: str | None = None) -> None:
+        """Move one dp replica off node_id (decommission, dead-node re-home,
+        and spread-repair all share this step)."""
+        repl = self._pick_addition(
+            "data", [p for p in dp.peers if p != node_id],
+            exclude={node_id},
+            prefer_zone=prefer_zone)
+        idx = dp.peers.index(node_id)
+        new_peers = [p for p in dp.peers if p != node_id] + [repl.node_id]
+        hosts = self._current_hosts(dp.peers, dp.hosts)
+        new_hosts = [h for i, h in enumerate(hosts) if i != idx] + [repl.addr]
+        if self.datanode_hook:
+            self.datanode_hook(dp.partition_id, new_peers, new_hosts,
+                               only=repl.node_id)
+        if self.raft_config_hook:
+            self.raft_config_hook("data", dp.partition_id, "add",
+                                  repl.node_id, dp.peers)
+            # include the victim in the contact set (see metanode path)
+            self.raft_config_hook("data", dp.partition_id, "remove",
+                                  node_id, dp.peers + [repl.node_id])
+        if self.remove_partition_hook:
+            self.remove_partition_hook("data", dp.partition_id, node_id)
+        self._apply("update_dp_members", vol_name=vol.name,
+                    partition_id=dp.partition_id, peers=new_peers,
+                    hosts=new_hosts)
+        if self.datanode_hook:
+            # idempotent re-send refreshes peers/hosts on survivors
+            # (their local meta still lists the victim)
+            self.datanode_hook(dp.partition_id, new_peers, new_hosts)
+
     def _migrate_datanode(self, node_id: int) -> int:
         moved = 0
+        zone = self.sm.nodes[node_id].zone
         for vol in list(self.sm.volumes.values()):
             for dp in vol.data_partitions:
                 if node_id not in dp.peers:
                     continue
-                repl = self._pick_addition(
-                    "data", [p for p in dp.peers if p != node_id],
-                    exclude={node_id},
-                    prefer_zone=self.sm.nodes[node_id].zone)
-                idx = dp.peers.index(node_id)
-                new_peers = [p for p in dp.peers if p != node_id] + [repl.node_id]
-                hosts = self._current_hosts(dp.peers, dp.hosts)
-                new_hosts = [h for i, h in enumerate(hosts) if i != idx] + [repl.addr]
-                if self.datanode_hook:
-                    self.datanode_hook(dp.partition_id, new_peers, new_hosts,
-                                       only=repl.node_id)
-                if self.raft_config_hook:
-                    self.raft_config_hook("data", dp.partition_id, "add",
-                                          repl.node_id, dp.peers)
-                    # include the victim in the contact set (see metanode path)
-                    self.raft_config_hook("data", dp.partition_id, "remove",
-                                          node_id, dp.peers + [repl.node_id])
-                if self.remove_partition_hook:
-                    self.remove_partition_hook("data", dp.partition_id, node_id)
-                self._apply("update_dp_members", vol_name=vol.name,
-                            partition_id=dp.partition_id, peers=new_peers,
-                            hosts=new_hosts)
-                if self.datanode_hook:
-                    # idempotent re-send refreshes peers/hosts on survivors
-                    # (their local meta still lists the victim)
-                    self.datanode_hook(dp.partition_id, new_peers, new_hosts)
+                self._move_dp_replica(vol, dp, node_id, prefer_zone=zone)
                 moved += 1
+        return moved
+
+    def check_replica_spread(self) -> int:
+        """Spread-repair sweep: a partition whose replicas CONCENTRATE in one
+        fault domain — the residue of re-homing while several domains were
+        dark — moves a doubled replica into an unrepresented healthy domain
+        once one exists again (the reference's balance machinery applied to
+        the domain axis). Data partitions only: mp moves are heavier
+        (snapshot transfer) and the same residue heals on the next mp
+        migration anyway."""
+        if not self.is_leader:
+            return 0
+        moved = 0
+        for vol in list(self.sm.volumes.values()):
+            for dp in vol.data_partitions:
+                by_dom: dict[str, list[int]] = {}
+                for p in dp.peers:
+                    n = self.sm.nodes.get(p)
+                    if n is None or not n.schedulable:
+                        continue  # dead peers are the re-home sweep's job
+                    by_dom.setdefault(self.domain_of(n.zone), []).append(p)
+                doubled = [ps for ps in by_dom.values() if len(ps) >= 2]
+                if not doubled:
+                    continue
+                free_doms = {
+                    self.domain_of(n.zone)
+                    for n in self.sm.nodes.values()
+                    if n.kind == "data" and n.schedulable
+                    and n.node_id not in dp.peers
+                } - set(by_dom)
+                if not free_doms:
+                    continue
+                victim = max(
+                    doubled[0],
+                    key=lambda p: self.sm.nodes[p].partition_count)
+                try:
+                    self._move_dp_replica(vol, dp, victim)
+                    moved += 1
+                except MasterError:
+                    pass  # no capacity after all; retried next sweep
         return moved
 
     # -- background checks (scheduleTask loop analogs) --------------------------

@@ -624,6 +624,7 @@ def test_domain_loss_soak(master, seed):
         master.check_node_liveness(timeout=10.0, now=now)
         master.check_data_partitions()
         master.check_dead_node_replicas(dead_after=60.0, now=now)
+        master.check_replica_spread()
 
         vol = master.get_volume("soak")
         dead_nodes = {n.node_id for n in master.sm.nodes.values()
@@ -850,3 +851,36 @@ def test_cluster_stat_rollup(master):
     # a repeat heartbeat without a space report leaves the numbers alone
     master.heartbeat(100)
     assert master.cluster_stat()["total_space"] == 7000
+
+
+def test_replica_spread_repair_sweep(master):
+    """Spread repair (found by the extended domain soak): a partition whose
+    replicas concentrated in one domain during a multi-domain outage moves
+    a doubled replica out once a free healthy domain returns; partitions
+    already spread, or with nowhere better to go, are left alone."""
+    import time as _time
+
+    _register_grid(master, "meta", zones=3, per_zone=1, base=100)
+    _register_grid(master, "data", zones=3, per_zone=2, base=200)
+    for z in range(3):
+        master.set_zone_domain(f"z{z}", f"d{z}")
+    vol = master.create_volume("sp", data_partitions=1)
+    dp = vol.data_partitions[0]
+    now = _time.time()
+    for n in master.sm.nodes.values():
+        n.last_heartbeat = now
+
+    # simulate the outage residue: both z0 nodes (domain d0) plus one z1
+    # node — d0 doubled, d2 unrepresented though healthy
+    z1_peer = next(p for p in dp.peers if master.sm.nodes[p].zone == "z1")
+    forced = [200, 201, z1_peer]
+    hosts = [master.sm.nodes[p].addr for p in forced]
+    master._apply("update_dp_members", vol_name="sp",
+                  partition_id=dp.partition_id, peers=forced, hosts=hosts)
+
+    assert master.check_replica_spread() == 1
+    peers = master.get_volume("sp").data_partitions[0].peers
+    doms = [master.domain_of(master.sm.nodes[p].zone) for p in peers]
+    assert sorted(doms) == ["d0", "d1", "d2"], doms
+    # idempotent: a spread partition is untouched
+    assert master.check_replica_spread() == 0
