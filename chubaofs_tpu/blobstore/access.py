@@ -31,6 +31,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from chubaofs_tpu import chaos
 from chubaofs_tpu.blobstore.blobnode import BlobNode
 from chubaofs_tpu.blobstore.clustermgr import ClusterMgr, VolumeInfo
 from chubaofs_tpu.blobstore.proxy import Proxy
@@ -194,6 +195,15 @@ class Access:
         # queued behind them would trade its millisecond latency for seconds
         self._read_pool = ThreadPoolExecutor(max_workers=max_workers,
                                              thread_name_prefix="access-read")
+        # background integrity probes get their OWN small executors: a probe
+        # against a wedged blobnode may pin its worker for the wedge duration,
+        # and that must starve neither PUT stripes nor GET hedges
+        self._probe_pool = ThreadPoolExecutor(max_workers=2,
+                                              thread_name_prefix="access-probe")
+        self._probe_io = ThreadPoolExecutor(max_workers=4,
+                                            thread_name_prefix="access-probe-io")
+        self._probing: set[tuple[int, int]] = set()  # (vid, bid) dedupe
+        self._probe_lock = threading.Lock()
 
     # -- failure containment --------------------------------------------------
 
@@ -313,6 +323,7 @@ class Access:
                 self.punish_disk(unit.disk_id, "cap_exhausted")
                 raise DiskPunished(f"disk {unit.disk_id} at concurrency cap")
             try:
+                chaos.failpoint("access.write_shard", node=unit.node_id)
                 node.create_vuid(unit.vuid, unit.disk_id)
                 node.put_shard(unit.vuid, bid, stripe[idx].tobytes())
             except ChunkFull:
@@ -510,6 +521,7 @@ class Access:
         if node is None:
             return None
         try:
+            chaos.failpoint("access.read_shard", node=unit.node_id)
             data = node.get_shard(unit.vuid, bid, offset=offset, size=size)
             if len(data) != size:
                 return None
@@ -541,34 +553,66 @@ class Access:
         slow = deprioritize or set()
         # data shards first (they skip the matmul); known-wedged ones last
         order = sorted(range(total), key=lambda i: (i in slow, i))
-        pending = {
-            self._read_pool.submit(
-                self._read_shard, vol, idx, blob.bid, 0, shard_len): idx
-            for idx in order[:t.read_hedge]
-        }
+        now = time.monotonic()
+        pending: dict = {}
+        launched: dict = {}  # future -> launch time (hang-hedge input)
+        hedged: set = set()  # futures already replaced for being slow
+
+        def launch(idx: int):
+            f = self._read_pool.submit(
+                self._read_shard, vol, idx, blob.bid, 0, shard_len)
+            pending[f] = idx
+            launched[f] = time.monotonic()
+
+        for idx in order[:t.read_hedge]:
+            launch(idx)
         next_i = t.read_hedge
         # overall gather budget: stragglers can be slow-but-alive, so this
         # is the generous write_deadline, not the per-read read_deadline
-        gather_deadline = time.monotonic() + self.write_deadline
+        gather_deadline = now + self.write_deadline
         while pending and len(present) < t.N:
+            # wake for the earliest of: gather budget, or the moment an
+            # un-hedged in-flight read crosses read_deadline
+            now = time.monotonic()
+            timeout = gather_deadline - now
+            nxt_slow = min((launched[f] + self.read_deadline
+                            for f in pending if f not in hedged), default=None)
+            if nxt_slow is not None:
+                timeout = min(timeout, nxt_slow - now)
             done, _ = wait(pending, return_when=FIRST_COMPLETED,
-                           timeout=max(0.0, gather_deadline - time.monotonic()))
-            if not done:  # budget exhausted: abandon what never answered
-                break
+                           timeout=max(0.0, timeout))
+            if not done:
+                now = time.monotonic()
+                if now >= gather_deadline:
+                    break  # budget exhausted: abandon what never answered
+                # an in-flight read exceeded read_deadline without FAILING —
+                # a hung-but-silent replica. Launch a replacement from the
+                # not-yet-tried shards (the original keeps running: slow-but-
+                # alive may still answer first), so hedge depth holds against
+                # hangs exactly as against failures.
+                for f in list(pending):
+                    if (f in hedged
+                            or now - launched[f] < self.read_deadline):
+                        continue
+                    hedged.add(f)
+                    if next_i < total:
+                        launch(order[next_i])
+                        next_i += 1
+                continue
             for fut in done:
                 idx = pending.pop(fut)
+                launched.pop(fut, None)
+                was_hedged = fut in hedged  # its replacement already launched
+                hedged.discard(fut)
                 data = fut.result()
                 if data is not None:
                     stripe[idx] = np.frombuffer(data, np.uint8)
                     present.append(idx)
-                elif next_i < total:  # replace the failure, keep hedge depth
-                    failed.append(idx)
-                    nxt = order[next_i]
-                    next_i += 1
-                    pending[self._read_pool.submit(
-                        self._read_shard, vol, nxt, blob.bid, 0, shard_len)] = nxt
                 else:
                     failed.append(idx)
+                    if not was_hedged and next_i < total:
+                        launch(order[next_i])  # keep hedge depth
+                        next_i += 1
         for fut in pending:  # abandon stragglers (queued ones cancel cleanly)
             fut.cancel()
         # the repair plane must hear about everything the gather PROVED
@@ -590,8 +634,18 @@ class Access:
         unprobed = [i for i in range(total)
                     if i not in present and i not in failed]
         if unprobed:
-            self._pool.submit(self._probe_shards, t, vol, blob, shard_len,
-                              unprobed)
+            # probes ride their OWN executor (never the PUT/write pool: a
+            # wedged blobnode would pin write workers and stall unrelated
+            # stripe writes) and dedupe per (vid, bid): a burst of degraded
+            # GETs of one hot blob probes it once
+            key = (vol.vid, blob.bid)
+            with self._probe_lock:
+                fresh = key not in self._probing
+                if fresh:
+                    self._probing.add(key)
+            if fresh:
+                self._probe_pool.submit(self._probe_shards, t, vol, blob,
+                                        shard_len, unprobed)
         data_region = fixed[: t.N].reshape(-1)
         return data_region[offset : offset + size].tobytes()
 
@@ -599,14 +653,34 @@ class Access:
         """Background integrity probe of shards a hedged gather skipped or
         abandoned: full CRC-framed reads, failures reported to the repair
         plane. Keeps get_miss healing as wide as the old full-stripe gather
-        without ever charging the GET's latency."""
-        bad = [i for i in idxs
-               if self._read_shard(vol, i, blob.bid, 0, shard_len) is None]
-        if bad:
-            try:
-                self.proxy.send_shard_repair(vol.vid, blob.bid, bad, "get_probe")
-            except Exception:
-                pass  # scrub/inspector sweeps remain the durable backstop
+        without ever charging the GET's latency. Every read is bounded by
+        read_deadline — a wedged node makes the probe REPORT, not hang."""
+        try:
+            futs = {self._probe_io.submit(
+                self._read_shard, vol, i, blob.bid, 0, shard_len): i
+                for i in idxs}
+            bad = []
+            for fut, i in futs.items():
+                try:
+                    data = fut.result(timeout=self.read_deadline)
+                except FutureTimeout:
+                    if fut.cancel():
+                        # never started (probe-pool backlog): its health is
+                        # UNKNOWN, not bad — the scrub sweeps cover it; a
+                        # repair message here would heal shards nobody read
+                        continue
+                    data = None  # ran past its deadline: wedged, report it
+                if data is None:
+                    bad.append(i)
+            if bad:
+                try:
+                    self.proxy.send_shard_repair(vol.vid, blob.bid, bad,
+                                                 "get_probe")
+                except Exception:
+                    pass  # scrub/inspector sweeps remain the durable backstop
+        finally:
+            with self._probe_lock:
+                self._probing.discard((vol.vid, blob.bid))
 
     # -- DELETE --------------------------------------------------------------
 

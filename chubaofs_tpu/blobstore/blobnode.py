@@ -34,6 +34,7 @@ import threading
 import zlib
 from dataclasses import dataclass
 
+from chubaofs_tpu import chaos
 from chubaofs_tpu.utils import crc32block
 from chubaofs_tpu.utils.kvstore import open_kv
 
@@ -462,10 +463,19 @@ class BlobNode:
     # -- shard API ----------------------------------------------------------
 
     def put_shard(self, vuid: int, bid: int, payload: bytes) -> None:
+        chaos.failpoint("blobnode.put_shard", node=self.node_id)
+        # corrupt-on-write models a bad controller: the framing CRCs the
+        # already-flipped bytes, so only a later stripe-level repair catches it
+        payload = chaos.corrupt_bytes("blobnode.put_shard.payload", payload,
+                                      node=self.node_id)
         self._chunk(vuid).put(bid, vuid, payload)
 
     def get_shard(self, vuid: int, bid: int, offset: int = 0, size: int | None = None) -> bytes:
-        return self._chunk(vuid).get(bid, offset, size)
+        chaos.failpoint("blobnode.get_shard", node=self.node_id)
+        data = self._chunk(vuid).get(bid, offset, size)
+        # corrupt-on-read models wire/DMA corruption past the CRC framing
+        return chaos.corrupt_bytes("blobnode.get_shard.data", data,
+                                   node=self.node_id)
 
     def mark_delete_shard(self, vuid: int, bid: int) -> None:
         self._chunk(vuid).mark_delete(bid)

@@ -1,0 +1,46 @@
+"""Chaos — deterministic fault injection for the whole stack.
+
+Three layers (ARCHITECTURE.md `## Chaos`):
+
+  * `chaos.failpoint(name)` call sites woven through the hot failure paths
+    (rpc, raft transport, datanode disk IO, extent-store CRC, blobnode shard
+    IO, access hedged gather, FUSE dispatch, meta submit, rs encode) — armed
+    per-name with error / delay / hang-until-released / drop / corrupt /
+    return-value actions, globally or per-node, with hit counters, budgets
+    and probabilities. Zero-overhead no-ops while nothing is armed.
+  * a seeded `ChaosScheduler` that drives fault plans (node wedge, slow
+    disk, link drop, shard bit-rot, process crash/restart) against a live
+    MiniCluster on a virtual timeline with a reproducible event log.
+  * the soak harness (`chaos.soak.run_soak`, `tools/chaos_soak.py`) that
+    proves PUT -> fault -> degraded GET -> heal -> converge with zero data
+    loss under each plan.
+
+Env-var control: `CFS_FAILPOINTS=blobnode.get_shard=delay(2.0);raft.send=
+drop@0.1` is parsed on first import, so daemon subprocesses inherit faults
+from the harness environment.
+"""
+
+from chubaofs_tpu.chaos.failpoints import (  # noqa: F401
+    Dropped,
+    FailpointError,
+    arm,
+    armed,
+    corrupt_bytes,
+    disarm,
+    failpoint,
+    fired,
+    hits,
+    load_env,
+    load_spec,
+    release,
+    reset,
+)
+from chubaofs_tpu.chaos.inject import corrupt_shard_on_disk  # noqa: F401
+from chubaofs_tpu.chaos.scheduler import (  # noqa: F401
+    ChaosScheduler,
+    Fault,
+    FaultPlan,
+    builtin_plan,
+)
+
+load_env()  # arm anything the harness put in CFS_FAILPOINTS

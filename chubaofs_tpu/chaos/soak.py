@@ -1,0 +1,144 @@
+"""Chaos soak — PUT -> fault -> degraded GET -> heal -> converge, seeded.
+
+The acceptance cycle behind `tools/chaos_soak.py` and tests/test_chaos.py:
+a MiniCluster takes writes, a ChaosScheduler injects a fault plan on the
+virtual timeline, every ACKED blob must read back byte-identical in every
+phase (degraded included) with bounded tail latency, and once the faults
+lift the repair planes must converge to a quiet inspector sweep with zero
+data loss. Everything is driven off seeded RNGs, so the injection event
+log is reproducible run-over-run.
+
+PUTs issued while a fault window is ACTIVE may be rejected by the put
+quorum (EC quorums tolerate one lost unit; a wedged two-disk node can
+legitimately hold two units of a stripe). A rejected PUT is correct
+degraded behavior — the data was never acked — and the soak retries it
+until it lands; an unacked blob is never counted against data loss. A
+rejection while NO fault is active fails the soak.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+from chubaofs_tpu.chaos import failpoints as fp
+from chubaofs_tpu.chaos.scheduler import ChaosScheduler, FaultPlan, builtin_plan
+
+SIZES = [8_000, 120_000, 700_000, 2_000_000]
+
+
+class SoakFailure(AssertionError):
+    pass
+
+
+def run_soak(root: str, plan: FaultPlan | str, seed: int, rounds: int = 6,
+             puts_per_round: int = 2, n_nodes: int = 9, disks_per_node: int = 2,
+             sizes: list[int] | None = None, read_deadline: float = 0.5,
+             write_deadline: float = 4.0, converge_sweeps: int = 12) -> dict:
+    """One full soak cycle; returns {events, puts, gets, max_get_s, ok, ...}.
+    Raises SoakFailure on data loss, latency-bound violation, or a cluster
+    that will not converge after the faults lift."""
+    import numpy as np
+
+    from chubaofs_tpu.blobstore.access import Access, AccessError
+    from chubaofs_tpu.blobstore.cluster import MiniCluster
+
+    if isinstance(plan, str):
+        plan = builtin_plan(plan, steps=rounds)
+    sizes = sizes or SIZES
+    rnd = random.Random(seed)          # op schedule
+    rng = np.random.default_rng(seed)  # payload bytes
+    c = MiniCluster(root, n_nodes=n_nodes, disks_per_node=disks_per_node)
+    # soak-tuned gateway: a wedged node must cost fractions of a second, not
+    # the production 3s/10s windows, and hung reads pin pool workers until
+    # the fault lifts — size the pools for that
+    c.access = Access(c.cm, c.proxy, c.nodes, codec=c.codec, max_workers=64,
+                      read_deadline=read_deadline,
+                      write_deadline=write_deadline)
+    sched = ChaosScheduler(c, plan, seed=seed + 1)
+    live = sched.blobs  # blob idx -> (Location, payload); shared by bitrot
+    # degraded GETs must finish inside the hedged-gather budget even with
+    # wedged replicas; generous margin for CI thread scheduling
+    get_bound = write_deadline + read_deadline + 5.0
+    stats = {"puts": 0, "puts_rejected": 0, "gets": 0, "max_get_s": 0.0}
+    next_id = 0
+    pending: list[bytes] = []  # payloads rejected under faults, to retry
+    try:
+        for _ in range(rounds):
+            for _ in range(puts_per_round):
+                size = rnd.choice(sizes)
+                pending.append(
+                    rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+            retry = []
+            for data in pending:
+                try:
+                    live[next_id] = (c.access.put(data), data)
+                    next_id += 1
+                    stats["puts"] += 1
+                except AccessError:
+                    if sched.quiesced():
+                        raise SoakFailure(
+                            f"t={sched.vtime}: PUT rejected with no fault "
+                            f"active under plan {plan.name} seed {seed}")
+                    stats["puts_rejected"] += 1
+                    retry.append(data)  # never acked: retry after heal
+            pending = retry
+
+            sched.step()
+
+            # pump the repair planes between faults
+            for _ in range(4):
+                s = c.run_background_once()
+                if (s["repair_msgs"] == 0 and s["disk_tasks"] == 0
+                        and s["tasks_ran"] == 0):
+                    break
+
+            # THE invariant: every acked blob reads byte-identical, degraded
+            # or healed, inside the latency bound
+            for idx, (loc, data) in live.items():
+                t0 = time.monotonic()
+                got = c.access.get(loc)
+                dt = time.monotonic() - t0
+                stats["gets"] += 1
+                stats["max_get_s"] = max(stats["max_get_s"], dt)
+                if got != data:
+                    raise SoakFailure(
+                        f"t={sched.vtime}: blob {idx} corrupted under "
+                        f"plan {plan.name} seed {seed}")
+                if dt > get_bound:
+                    raise SoakFailure(
+                        f"t={sched.vtime}: blob {idx} GET took {dt:.2f}s "
+                        f"(bound {get_bound:.2f}s) under plan {plan.name}")
+
+        # lift anything still active, land the retries, then CONVERGE:
+        # repair planes drain and a full inspector sweep goes quiet
+        sched.close()
+        for data in pending:
+            live[next_id] = (c.access.put(data), data)
+            next_id += 1
+            stats["puts"] += 1
+        converged = False
+        for _ in range(converge_sweeps):
+            c.run_background_once()
+            if c.scheduler.inspect_volumes(max_volumes=1000) == 0:
+                converged = True
+                break
+        if not converged:
+            raise SoakFailure(
+                f"plan {plan.name} seed {seed}: inspector never went quiet "
+                f"after faults lifted")
+        for idx, (loc, data) in live.items():
+            if c.access.get(loc) != data:
+                raise SoakFailure(
+                    f"post-heal: blob {idx} lost under plan {plan.name}")
+        # how often each injection actually bit (anti-vacuous-green signal:
+        # a soak whose faults never fire has tested nothing)
+        fired = {n: fp.fired(n) for n in
+                 ("access.read_shard", "access.write_shard", "raft.send")}
+        return {"plan": plan.name, "seed": seed, "events": list(sched.events),
+                "ok": True, "fired": {k: v for k, v in fired.items() if v},
+                **stats}
+    finally:
+        sched.close()
+        fp.reset()  # never leak armings into the next soak/test
+        c.close()

@@ -46,6 +46,7 @@ import struct
 import threading
 import time
 
+from chubaofs_tpu import chaos
 from chubaofs_tpu.meta.metanode import OpError
 from chubaofs_tpu.sdk.fs import FsClient, FsError
 
@@ -179,6 +180,10 @@ class FuseServer:
         self.devfd = -1
         self._next_fh = 1
         self._fhs: dict[int, _Handle] = {}
+        # OPENDIR snapshots: fh -> [(name, ino, mode)]. READDIR offsets index
+        # the snapshot, so a directory mutated between two READDIR batches
+        # never skips or repeats entries within one open handle
+        self._dirhs: dict[int, list[tuple[str, int, int]]] = {}
         self._open_count: dict[int, int] = {}
         self._orphans: set[int] = set()
         self._lock = threading.Lock()
@@ -248,6 +253,9 @@ class FuseServer:
                     err = "ENOSYS"
                     self._reply_err(unique, errno_mod.ENOSYS)
                     continue
+                # injected faults surface as errno to the UNMODIFIED program
+                # above the VFS (error -> EIO, delay/hang -> a stalled call)
+                chaos.failpoint("fuse.dispatch")
                 payload = handler(self, nodeid, body, uid, gid)
                 self._reply(unique, payload or b"")
             except (FsError, OpError) as e:
@@ -381,14 +389,48 @@ class FuseServer:
         ino, _ = self.fs._remove_node(nodeid, name, want_dir=True, path=name)
         self.meta.evict_inode(ino)
 
-    def _rename(self, nodeid: int, newdir: int, rest: bytes) -> None:
+    RENAME_NOREPLACE = 1  # linux/fs.h RENAME_* flags
+
+    def _rename(self, nodeid: int, newdir: int, rest: bytes,
+                noreplace: bool = False) -> None:
         src, dst = rest.split(b"\0")[:2]
         try:
-            self.meta.rename(nodeid, src.decode(), newdir, dst.decode(),
-                             src_quota_ids=self.fs._parent_quota_ids(nodeid),
-                             dst_quota_ids=self.fs._parent_quota_ids(newdir))
+            if noreplace and self._exists(newdir, dst.decode()):
+                raise FsError("EEXIST", dst.decode())
+            displaced = self.meta.rename(
+                nodeid, src.decode(), newdir, dst.decode(),
+                src_quota_ids=self.fs._parent_quota_ids(nodeid),
+                dst_quota_ids=self.fs._parent_quota_ids(newdir))
         except OpError as e:
             raise FsError(e.code) from None
+        if not displaced:
+            return
+        # same contract as _do_unlink: an inode displaced while open joins
+        # _orphans and its LAST RELEASE evicts it; otherwise evict now
+        ino, nlink, is_dir = displaced
+        if not ino:
+            return
+        if is_dir:
+            self.meta.evict_inode(ino)  # empty dir: no open-handle grace
+            return
+        if nlink <= 0:
+            with self._lock:
+                still_open = self._open_count.get(ino, 0) > 0
+                if still_open:
+                    self._orphans.add(ino)
+            if not still_open:
+                self.fs.evict_ino(ino)
+
+    def _exists(self, parent: int, name: str) -> bool:
+        try:
+            self.meta.lookup(parent, name)
+            return True
+        except OpError as e:
+            if e.code == "ENOENT":
+                return False
+            # a transient lookup failure must NOT read as "absent": that
+            # would let NOREPLACE clobber the very file it protects
+            raise FsError(e.code, name) from None
 
     def _do_rename(self, nodeid, body, uid, gid) -> None:
         (newdir,) = RENAME_IN.unpack_from(body)
@@ -396,9 +438,10 @@ class FuseServer:
 
     def _do_rename2(self, nodeid, body, uid, gid) -> None:
         newdir, flags, _pad = RENAME2_IN.unpack_from(body)
-        if flags:  # RENAME_NOREPLACE/EXCHANGE not in the meta rename contract
+        if flags & ~self.RENAME_NOREPLACE:  # EXCHANGE/WHITEOUT unsupported
             raise FsError("EINVAL", f"rename2 flags {flags:#x}")
-        self._rename(nodeid, newdir, body[RENAME2_IN.size:])
+        self._rename(nodeid, newdir, body[RENAME2_IN.size:],
+                     noreplace=bool(flags & self.RENAME_NOREPLACE))
 
     def _do_link(self, nodeid, body, uid, gid) -> bytes:
         (oldnode,) = LINK_IN.unpack_from(body)
@@ -476,12 +519,7 @@ class FuseServer:
     def _do_fsync(self, nodeid, body, uid, gid) -> None:
         return None
 
-    def _do_opendir(self, nodeid, body, uid, gid) -> bytes:
-        self._inode(nodeid)
-        return OPEN_OUT.pack(0, 0, 0)
-
-    def _do_readdir(self, nodeid, body, uid, gid) -> bytes:
-        _fh, offset, size, *_ = READ_IN.unpack_from(body)
+    def _list_dir(self, nodeid) -> list[tuple[str, int, int]]:
         try:
             dentries = self.meta.read_dir(nodeid)
         except OpError as e:
@@ -489,6 +527,24 @@ class FuseServer:
         entries = [(".", nodeid, stat_mod.S_IFDIR),
                    ("..", nodeid, stat_mod.S_IFDIR)]
         entries += [(d.name, d.ino, d.mode) for d in dentries]
+        return entries
+
+    def _do_opendir(self, nodeid, body, uid, gid) -> bytes:
+        # snapshot the listing into a REAL fh: READDIR resumes by positional
+        # offset, and re-fetching on every batch would skip/duplicate entries
+        # whenever the directory mutates between batches of a large listing
+        entries = self._list_dir(nodeid)
+        with self._lock:
+            fh = self._next_fh
+            self._next_fh += 1
+            self._dirhs[fh] = entries
+        return OPEN_OUT.pack(fh, 0, 0)
+
+    def _do_readdir(self, nodeid, body, uid, gid) -> bytes:
+        fh, offset, size, *_ = READ_IN.unpack_from(body)
+        entries = self._dirhs.get(fh)
+        if entries is None:  # unknown fh (e.g. server restart): best effort
+            entries = self._list_dir(nodeid)
         out = bytearray()
         for i, (name, ino, mode) in enumerate(entries):
             if i < offset:
@@ -502,6 +558,9 @@ class FuseServer:
         return bytes(out)
 
     def _do_releasedir(self, nodeid, body, uid, gid) -> None:
+        fh, *_ = RELEASE_IN.unpack_from(body)
+        with self._lock:
+            self._dirhs.pop(fh, None)
         return None
 
     def _do_statfs(self, nodeid, body, uid, gid) -> bytes:

@@ -287,7 +287,20 @@ class Mount:
 
     def rename(self, src: str, dst: str) -> None:
         def run():
-            self.fs.rename(src, dst)
+            # same orphan contract as unlink(): an inode displaced by
+            # rename-over while open stays readable until its last close
+            displaced = self.fs.rename(src, dst, evict_displaced=False)
+            if displaced:
+                ino, nlink, is_dir = displaced
+                if is_dir:
+                    self.fs.evict_ino(ino)
+                elif ino and nlink <= 0:
+                    with self._lock:
+                        still_open = self._open_count.get(ino, 0) > 0
+                        if still_open:
+                            self._orphans.add(ino)
+                    if not still_open:
+                        self.fs.evict_ino(ino)
             self._invalidate_prefix(src)
             self._invalidate_prefix(dst)
 

@@ -26,6 +26,7 @@ import os
 import shutil
 import threading
 
+from chubaofs_tpu import chaos
 from chubaofs_tpu.data.repl import FollowerAckError, ReplError, ReplServer
 from chubaofs_tpu.proto.packet import (
     OP_CREATE_EXTENT, OP_CREATE_PARTITION, OP_GET_PARTITION_METRICS,
@@ -215,6 +216,9 @@ class DataNode:
                 else contextlib.nullcontext())
         try:
             with lane:
+                # injected disk-lane faults surface as RES_DISK_ERR below,
+                # exactly the path a real EIO from the store takes
+                chaos.failpoint("datanode.op", node=self.node_id)
                 return handler(self, pkt)
         except ExtentNotFound as e:
             return pkt.reply(RES_NOT_EXIST, arg={"error": str(e)})

@@ -530,18 +530,48 @@ class MetaPartitionSM(StateMachine):
                          dst_name: str, src_quota_ids: list[int] | None = None,
                          dst_quota_ids: list[int] | None = None):
         """Atomic rename when both dentries live in this partition. The move
-        leaves the source quota and enters the destination's."""
+        leaves the source quota and enters the destination's.
+
+        POSIX rename(2) REPLACE semantics: an existing destination is
+        atomically displaced in the same commit — its dentry drops, and when
+        this partition also owns its inode, the link drops too. Returns
+        (new_dentry, displaced_ino, displaced_nlink, displaced_is_dir) where
+        displaced_ino == 0 means nothing was displaced and displaced_nlink
+        == -1 means the displaced inode lives in another partition (the
+        client must unlink it via the per-op flow)."""
         self._check_lock(("d", src_parent, src_name))
         self._check_lock(("d", dst_parent, dst_name))
         d = self.dentries.get((src_parent, src_name))
         if d is None:
             raise NoEntry(f"{src_name!r} in {src_parent}")
-        if (dst_parent, dst_name) in self.dentries:
-            raise Exists(f"{dst_name!r} in {dst_parent}")
+        displaced_ino, displaced_nlink = 0, -1
+        displaced_is_dir = False
+        displaced = self.dentries.get((dst_parent, dst_name))
+        if displaced is not None:
+            if displaced.ino == d.ino:
+                # both names are links to ONE inode: rename(2) succeeds and
+                # does nothing (POSIX "oldpath and newpath are hard links")
+                return (d, 0, -1, False)
+            src_is_dir = stat_mod.S_ISDIR(d.mode)
+            displaced_is_dir = stat_mod.S_ISDIR(displaced.mode)
+            if src_is_dir and not displaced_is_dir:
+                raise NotDir(f"{dst_name!r} in {dst_parent}")
+            if not src_is_dir and displaced_is_dir:
+                raise IsDir(f"{dst_name!r} in {dst_parent}")
+            if displaced_is_dir and self.children.get(displaced.ino):
+                raise NotEmpty(f"{dst_name!r}")
+            # drop the displaced dentry + its link inside THIS commit: no
+            # window where dst is missing, no window with two dsts
+            self._op_delete_dentry(dst_parent, dst_name,
+                                   quota_ids=dst_quota_ids)
+            displaced_ino = displaced.ino
+            if self.owns_ino(displaced.ino) and displaced.ino in self.inodes:
+                displaced_nlink = self._op_unlink_inode(displaced.ino).nlink
         self._op_create_dentry(dst_parent, dst_name, d.ino, d.mode,
                                quota_ids=dst_quota_ids)
         self._op_delete_dentry(src_parent, src_name, quota_ids=src_quota_ids)
-        return self.dentries[(dst_parent, dst_name)]
+        return (self.dentries[(dst_parent, dst_name)], displaced_ino,
+                displaced_nlink, displaced_is_dir)
 
     def _op_link(self, parent: int, name: str, ino: int):
         inode = self._get_inode(ino)

@@ -26,6 +26,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from chubaofs_tpu import chaos
 from chubaofs_tpu.ops import bitmatrix, gf256
 
 BITS = 8
@@ -177,6 +178,9 @@ class RSKernel:
 
     def encode_parity(self, data: jax.Array, *, portable: bool = False) -> jax.Array:
         """(..., n, k) data -> (..., m, k) parity."""
+        # hot-path failpoint: the guard test in tests/test_chaos.py pins this
+        # to zero measurable overhead while unarmed
+        chaos.failpoint("rs.encode")
         fn = gf_matmul_bytes if portable else gf_matmul_dispatch
         return fn(self.parity_bits, jnp.asarray(data))
 

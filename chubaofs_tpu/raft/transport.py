@@ -28,6 +28,7 @@ import socket
 import struct
 import threading
 
+from chubaofs_tpu import chaos
 from chubaofs_tpu.raft import codec
 from chubaofs_tpu.raft.core import Entry, Msg
 
@@ -180,6 +181,12 @@ class TcpNet:
         for m in msgs:
             by_dst.setdefault(m.dst, []).append(m)
         for dst, batch in by_dst.items():
+            try:
+                # injected link loss/flap: drop the batch on the floor —
+                # raft re-sends via the next tick, exactly like real loss
+                chaos.failpoint("raft.send", node=self.node_id)
+            except chaos.FailpointError:
+                continue
             if dst == self.node_id:
                 if self.node is not None:
                     self.node.deliver(batch)

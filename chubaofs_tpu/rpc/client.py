@@ -13,6 +13,7 @@ import http.client
 import time
 import zlib
 
+from chubaofs_tpu import chaos
 from chubaofs_tpu.rpc.errors import HTTPError
 from chubaofs_tpu.rpc.server import AUTH_HEADER, CRC_HEADER, sign_path
 
@@ -48,6 +49,9 @@ class RPCClient:
         for attempt in range(self.retries):
             host = self._next_host()
             try:
+                # FailpointError IS a ConnectionError: an injected fault takes
+                # the real retry/rotate path below, no special handling
+                chaos.failpoint("rpc.client.do")
                 conn = http.client.HTTPConnection(host, timeout=self.timeout)
                 try:
                     conn.request(method, path, body=body or None, headers=hdrs)

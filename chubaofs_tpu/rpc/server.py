@@ -17,6 +17,7 @@ import time
 import zlib
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from chubaofs_tpu import chaos
 from chubaofs_tpu.rpc.router import Request, Response, Router, parse_request
 
 AUTH_HEADER = "blob-auth"
@@ -102,6 +103,9 @@ class RPCServer:
                 req = parse_request(self.command, self.path,
                                     dict(self.headers.items()), body,
                                     remote=self.client_address[0])
+                # error/hang here = handler dies before replying: the client
+                # sees a dropped connection, its retry/backoff path fires
+                chaos.failpoint("rpc.server.handle")
                 resp = outer.router.dispatch(req)
                 self.send_response(resp.status)
                 payload = b"" if self.command == "HEAD" else resp.body

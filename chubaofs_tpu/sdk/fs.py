@@ -348,15 +348,26 @@ class FsClient:
         except OpError as e:
             raise FsError(e.code, f"ino {ino}") from None
 
-    def rename(self, src: str, dst: str) -> None:
+    def rename(self, src: str, dst: str, evict_displaced: bool = True):
+        """POSIX replace semantics: an existing destination is displaced.
+        With evict_displaced (default, mirrors unlink(evict=True)) a fully
+        unlinked displaced inode is evicted here; callers holding their own
+        open-handle tables (Mount, the FUSE server) pass False and apply
+        their orphan contract to the returned (ino, nlink, is_dir)."""
         sp, sn = self._resolve_parent(src)
         dp, dn = self._resolve_parent(dst)
         try:
-            self.meta.rename(sp, sn, dp, dn,
-                             src_quota_ids=self._parent_quota_ids(sp),
-                             dst_quota_ids=self._parent_quota_ids(dp))
+            displaced = self.meta.rename(
+                sp, sn, dp, dn,
+                src_quota_ids=self._parent_quota_ids(sp),
+                dst_quota_ids=self._parent_quota_ids(dp))
         except OpError as e:
             raise FsError(e.code, f"{src} -> {dst}") from None
+        if displaced and evict_displaced:
+            ino, nlink, is_dir = displaced
+            if ino and (is_dir or nlink <= 0):
+                self.evict_ino(ino)
+        return displaced
 
     def stat(self, path: str) -> dict:
         try:

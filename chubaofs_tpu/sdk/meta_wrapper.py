@@ -97,6 +97,9 @@ class MetaWrapper:
         raise last or MasterError(f"partition {mp.partition_id}: no leader reachable")
 
     def submit(self, mp: MetaPartitionView, op: str, **args):
+        from chubaofs_tpu import chaos
+
+        chaos.failpoint("meta.submit")
         # the uniq id makes the mutation idempotent end-to-end, so even an
         # after-send connection loss (EIO) may retry safely
         args["_uniq"] = (self.client_id, next(self._uniq))
@@ -203,14 +206,43 @@ class MetaWrapper:
     def rename(self, src_parent: int, src_name: str, dst_parent: int,
                dst_name: str, src_quota_ids: list[int] | None = None,
                dst_quota_ids: list[int] | None = None):
+        """POSIX replace semantics: an existing destination is displaced.
+        Returns (displaced_ino, displaced_nlink, displaced_is_dir) when a
+        destination was displaced (the caller owns its orphan/evict
+        contract), else None."""
+        import stat as stat_mod
+
         src_mp = self.partition_of(src_parent)
         dst_mp = self.partition_of(dst_parent)
         if src_mp.partition_id == dst_mp.partition_id:
-            return self.submit(
+            # rename-over of a directory whose INODE lives in another
+            # partition: the commit's own children check cannot see its
+            # dentries, so emptiness is pre-checked at the owning partition
+            # (best-effort; the owned case stays atomic in _op_rename_local)
+            try:
+                dd = self._on_partition(dst_mp, lambda n: n.lookup(
+                    dst_mp.partition_id, dst_parent, dst_name))
+            except OpError as e:
+                if e.code != "ENOENT":
+                    raise
+                dd = None
+            if dd is not None and stat_mod.S_ISDIR(dd.mode) and \
+                    self.partition_of(dd.ino).partition_id != \
+                    src_mp.partition_id and self._dir_has_children(dd.ino):
+                raise OpError("ENOTEMPTY", f"{dst_name!r} in {dst_parent}")
+            res = self.submit(
                 src_mp, "rename_local", src_parent=src_parent, src_name=src_name,
                 dst_parent=dst_parent, dst_name=dst_name,
                 src_quota_ids=src_quota_ids or [], dst_quota_ids=dst_quota_ids or [],
             )
+            _, ino, nlink, is_dir = res
+            if not ino:
+                return None
+            if nlink == -1:
+                # displaced inode lives in another partition: the combined
+                # commit dropped its dentry; drop its link here
+                nlink = self.unlink_inode(ino).nlink
+            return (ino, nlink, is_dir)
         # cross-partition: two-phase transaction (metanode/transaction.go).
         # Prepare takes intent locks + validates on both shards. The DST
         # partition is the transaction manager: its commit is THE decision —
@@ -219,6 +251,39 @@ class MetaWrapper:
         import uuid
 
         d = self._on_partition(src_mp, lambda n: n.lookup(src_mp.partition_id, src_parent, src_name))
+        # replace semantics across partitions: the 2PC create would conflict
+        # on Exists at prepare, so an existing destination is removed FIRST
+        # in its own commit. Not atomic with the move (a reader can see dst
+        # briefly missing), but never two destinations — the single-shard
+        # common case stays fully atomic via rename_local above. If the move
+        # then fails, the removed destination is restored best-effort below.
+        displaced = None
+        dd = None
+        try:
+            dd = self._on_partition(dst_mp, lambda n: n.lookup(
+                dst_mp.partition_id, dst_parent, dst_name))
+        except OpError as e:
+            if e.code != "ENOENT":
+                raise
+        if dd is not None:
+            if dd.ino == d.ino:
+                return None  # hard links to one inode: POSIX no-op
+            src_is_dir = stat_mod.S_ISDIR(d.mode)
+            dst_is_dir = stat_mod.S_ISDIR(dd.mode)
+            if src_is_dir and not dst_is_dir:
+                raise OpError("ENOTDIR", f"{dst_name!r} in {dst_parent}")
+            if not src_is_dir and dst_is_dir:
+                raise OpError("EISDIR", f"{dst_name!r} in {dst_parent}")
+            if dst_is_dir and self.partition_of(dd.ino).partition_id != \
+                    dst_mp.partition_id and self._dir_has_children(dd.ino):
+                raise OpError("ENOTEMPTY", f"{dst_name!r} in {dst_parent}")
+            res = self.remove_entry(dst_parent, dst_name, want_dir=dst_is_dir,
+                                    quota_ids=dst_quota_ids)
+            if res is None:  # child inode on a third partition: per-op flow
+                self.delete_dentry(dst_parent, dst_name,
+                                   quota_ids=dst_quota_ids)
+                res = (dd.ino, self.unlink_inode(dd.ino).nlink)
+            displaced = (res[0], res[1], dst_is_dir)
         tx_id = f"tx-{self.client_id}-{uuid.uuid4().hex[:12]}"
         deadline = time.time() + self.TX_TTL
         tm_pid = dst_mp.partition_id
@@ -237,21 +302,44 @@ class MetaWrapper:
                 self.submit(mp, "tx_prepare", tx_id=tx_id, ops=ops,
                             deadline=deadline, tm_pid=tm_pid)
                 prepared.append(mp)
+            # TM commit — the point of no return. After it lands, participant
+            # commits are best-effort: the sweep rolls any straggler forward.
+            self.submit(dst_mp, "tx_commit", tx_id=tx_id)
         except OpError:
             for mp in prepared:
                 try:
                     self.submit(mp, "tx_rollback", tx_id=tx_id)
                 except OpError:
                     pass  # expiry sweep covers it
+            # a failed rename must leave the destination intact: restore the
+            # dentry we removed above (link() also restores the file's
+            # nlink; a dir gets its dentry back — best effort by design)
+            if displaced is not None and dd is not None:
+                try:
+                    if stat_mod.S_ISDIR(dd.mode):
+                        self.create_dentry(dst_parent, dst_name, dd.ino,
+                                           dd.mode, quota_ids=dst_quota_ids)
+                    else:
+                        self.link(dst_parent, dst_name, dd.ino)
+                except OpError:
+                    pass  # dst stays missing; its inode is already orphaned
             raise
-        # TM commit first — the point of no return. After it lands, participant
-        # commits are best-effort: the sweep rolls any straggler forward.
-        self.submit(dst_mp, "tx_commit", tx_id=tx_id)
         try:
             self.submit(src_mp, "tx_commit", tx_id=tx_id)
         except OpError:
             pass  # resolved by the participant sweep against the TM
-        return None
+        return displaced
+
+    def _dir_has_children(self, ino: int) -> bool:
+        """Emptiness as seen by the partition that OWNS the directory's
+        inode — a dir's child dentries route by the dir's ino, so a check on
+        the dst dentry's partition is blind to children living elsewhere."""
+        mp = self.partition_of(ino)
+        try:
+            return bool(self._on_partition(
+                mp, lambda n: n.read_dir(mp.partition_id, ino)))
+        except OpError:
+            return False
 
     # -- directory quotas (master_quota_manager + metanode quota analog) --------
 

@@ -32,6 +32,7 @@ import struct
 import threading
 import zlib
 
+from chubaofs_tpu import chaos
 from chubaofs_tpu.proto.packet import TINY_EXTENT_COUNT, is_tiny_extent
 
 BLOCK_SIZE = 64 * 1024  # CRC granularity (storage/extent.go block crc)
@@ -184,6 +185,7 @@ class ExtentStore:
 
         Append-only discipline of extent_store.go:327: a non-overwrite write
         must land at the current watermark (tiny: page-aligned watermark)."""
+        chaos.failpoint("extent_store.write")
         if crc is not None and zlib.crc32(data) != crc:
             raise StorageError("payload crc mismatch")
         with self._lock:
@@ -206,6 +208,7 @@ class ExtentStore:
             self._update_block_crcs(extent_id, offset, len(data))
 
     def read(self, extent_id: int, offset: int, size: int, verify: bool = True) -> bytes:
+        chaos.failpoint("extent_store.read")
         with self._lock:
             p = self._path(extent_id)
             if not os.path.exists(p) or extent_id in self._deleted:
@@ -214,7 +217,12 @@ class ExtentStore:
                 self._verify_blocks(extent_id, offset, size)
             with open(p, "rb") as f:
                 f.seek(offset)
-                return f.read(size)
+                # corrupt-past-CRC: the block CRCs verified above read the
+                # FILE again, so a flip here models the disk returning bad
+                # bytes after a clean verify (the repair plane's blind spot
+                # the inspector scrub exists for)
+                return chaos.corrupt_bytes("extent_store.read.data",
+                                           f.read(size))
 
     # -- delete ----------------------------------------------------------------
 

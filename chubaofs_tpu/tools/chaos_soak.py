@@ -1,0 +1,93 @@
+"""cfs-chaos-soak — seeded chaos soak against an in-process MiniCluster.
+
+The acceptance harness for the chaos subsystem: for each fault plan it runs
+PUT -> fault -> degraded GET -> heal -> converge and fails loudly on data
+loss, unbounded tail latency, or a cluster that will not converge. With
+--verify-repro each plan runs TWICE and the injection event logs must be
+byte-identical — the determinism contract that makes a chaos failure
+debuggable by replaying its seed.
+
+    cfs-chaos-soak --seed 7                  # the 3 acceptance plans
+    cfs-chaos-soak --plan link_drop --rounds 8 --verify-repro
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+ACCEPTANCE_PLANS = ["node_wedge", "link_drop", "shard_bitrot"]
+ALL_PLANS = ACCEPTANCE_PLANS + ["slow_disk", "crash_restart"]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="cfs-chaos-soak", description=__doc__)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--plan", action="append", choices=ALL_PLANS, default=[],
+                   help="fault plan (repeatable; default: the 3 acceptance "
+                        "plans)")
+    p.add_argument("--rounds", type=int, default=6)
+    p.add_argument("--puts-per-round", type=int, default=2)
+    p.add_argument("--nodes", type=int, default=9)
+    p.add_argument("--disks-per-node", type=int, default=2)
+    p.add_argument("--root", default=None,
+                   help="state dir (default: a fresh temp dir per plan)")
+    p.add_argument("--verify-repro", action="store_true",
+                   help="run each plan twice; event logs must be identical")
+    p.add_argument("--json", action="store_true", help="machine-readable out")
+    args = p.parse_args(argv)
+
+    from chubaofs_tpu.chaos.soak import SoakFailure, run_soak
+
+    plans = args.plan or ACCEPTANCE_PLANS
+    results = []
+    ok = True
+    for plan in plans:
+        runs = 2 if args.verify_repro else 1
+        logs = []
+        for i in range(runs):
+            if args.root:
+                root = os.path.join(args.root, f"{plan}-{i}")
+            else:
+                root = tempfile.mkdtemp(prefix=f"chaos-{plan}-")
+            try:
+                res = run_soak(root, plan, seed=args.seed,
+                               rounds=args.rounds,
+                               puts_per_round=args.puts_per_round,
+                               n_nodes=args.nodes,
+                               disks_per_node=args.disks_per_node)
+            except SoakFailure as e:
+                ok = False
+                res = {"plan": plan, "seed": args.seed, "ok": False,
+                       "error": str(e)}
+            logs.append(res.get("events"))
+            results.append(res)
+            if not res.get("ok"):
+                break
+        if args.verify_repro and len(logs) == 2 and logs[0] != logs[1]:
+            ok = False
+            results.append({"plan": plan, "ok": False,
+                            "error": "event logs diverged across identical "
+                                     "seeded runs"})
+    if args.json:
+        print(json.dumps({"ok": ok, "results": results}, indent=2))
+    else:
+        for r in results:
+            status = "OK " if r.get("ok") else "FAIL"
+            extra = (f"puts={r.get('puts')} rejected={r.get('puts_rejected')}"
+                     f" gets={r.get('gets')}"
+                     f" max_get={r.get('max_get_s', 0):.2f}s"
+                     if r.get("ok") else r.get("error", ""))
+            print(f"[{status}] plan={r['plan']} seed={r.get('seed')} {extra}")
+            for ev in r.get("events") or []:
+                print(f"         t={ev['t']} {ev['event']} {ev['fault']}"
+                      + "".join(f" {k}={v}" for k, v in ev.items()
+                                if k not in ("t", "event", "fault")))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

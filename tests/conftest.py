@@ -30,16 +30,15 @@ def rng():
     return np.random.default_rng(0)
 
 
-def corrupt_shard_on_disk(node, vuid, bid, flip_at=10):
-    """Flip one payload byte inside a blobnode chunk's crc32block framing,
-    bypassing the API (shared fault injector for the hygiene and soak
-    suites — byte-offset-sensitive, keep the one copy)."""
-    from chubaofs_tpu.blobstore.blobnode import HEADER_LEN
+# the one shared bit-rot injector now lives with the chaos subsystem
+# (chaos/inject.py); re-exported so older suites keep their import path
+from chubaofs_tpu.chaos.inject import corrupt_shard_on_disk  # noqa: E402, F401
 
-    chunk = node._chunk(vuid)
-    meta = chunk.shards[bid]
-    with open(chunk._data_path, "r+b") as f:
-        f.seek(meta.offset + HEADER_LEN + 4 + flip_at)  # into block 0 payload
-        b = f.read(1)
-        f.seek(-1, os.SEEK_CUR)
-        f.write(bytes([b[0] ^ 0xFF]))
+
+@pytest.fixture(autouse=True)
+def _chaos_clean():
+    """No test may leak armed failpoints into the next one."""
+    from chubaofs_tpu import chaos
+
+    yield
+    chaos.reset()

@@ -113,6 +113,48 @@ def test_errors_surface_as_errno(mnt):
     assert ei.value.errno == errno.ENOTDIR
 
 
+def test_rename_over_existing(mnt):
+    """POSIX rename(2) replace semantics through the kernel: mv over an
+    existing file (editors' atomic-save) must succeed, and a displaced
+    inode held open stays readable until its last close (same orphan
+    contract as unlink)."""
+    a, b = os.path.join(mnt, "ro_a.txt"), os.path.join(mnt, "ro_b.txt")
+    with open(a, "wb") as f:
+        f.write(b"new content")
+    with open(b, "wb") as f:
+        f.write(b"old content")
+    held = open(b, "rb")  # displaced-while-open
+    os.rename(a, b)  # must NOT raise EEXIST
+    assert open(b, "rb").read() == b"new content"
+    assert not os.path.exists(a)
+    assert held.read() == b"old content"  # orphan stays readable
+    held.close()
+    os.unlink(b)
+
+
+def test_rename_over_via_mv_tool(mnt):
+    """The unmodified coreutils path: `mv` onto an existing target."""
+    r = subprocess.run("echo newer > mv_a && echo older > mv_b && "
+                       "mv mv_a mv_b && cat mv_b",
+                       shell=True, capture_output=True, text=True, cwd=mnt)
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip() == "newer"
+
+
+def test_rename_over_directory(mnt):
+    d1, d2 = os.path.join(mnt, "rod_1"), os.path.join(mnt, "rod_2")
+    os.mkdir(d1)
+    os.mkdir(d2)
+    os.rename(d1, d2)  # empty dir over empty dir: allowed
+    assert os.path.isdir(d2) and not os.path.exists(d1)
+    os.mkdir(d1)
+    with open(os.path.join(d2, "child"), "w") as f:
+        f.write("x")
+    with pytest.raises(OSError) as ei:
+        os.rename(d1, d2)  # dir over NON-EMPTY dir
+    assert ei.value.errno in (errno.ENOTEMPTY, errno.EEXIST)
+
+
 def test_unlinked_open_file_stays_readable(mnt):
     """The orphan-inode contract through the real kernel."""
     p = os.path.join(mnt, "orphan.txt")
@@ -236,7 +278,7 @@ fd = os.open(path, os.O_CREAT | os.O_RDWR)
 MAXLEN = 300_000
 for step in range(120):
     op = rnd.choice(["write", "write", "write", "read", "truncate",
-                     "reopen", "rename", "link_cycle"])
+                     "reopen", "rename", "rename_over", "link_cycle"])
     if op == "write":
         off = rnd.randrange(0, max(1, len(shadow) + 1))
         n = rnd.randrange(1, 40_000)
@@ -272,6 +314,19 @@ for step in range(120):
         new = b if path == a else a  # alternate, never a self-rename
         os.rename(path, new)
         path = new
+        fd = os.open(path, os.O_RDWR)
+    elif op == "rename_over":
+        # POSIX replace: rename ONTO an existing victim file; content and
+        # size must ride with the renamed inode, the victim must vanish.
+        # Alternate targets so the victim is never the live file itself.
+        os.close(fd)
+        a = os.path.join(mnt, f"fsx_{seed}.dat")
+        b = os.path.join(mnt, f"fsx_{seed}_v.dat")
+        victim = b if path == a else a
+        with open(victim, "wb") as g:
+            g.write(b"victim-%d" % step)
+        os.rename(path, victim)
+        path = victim
         fd = os.open(path, os.O_RDWR)
     elif op == "link_cycle":
         lnk = path + ".lnk"
