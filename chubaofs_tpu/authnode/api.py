@@ -43,6 +43,16 @@ def build_router(node: AuthNode, admin_secret: bytes | None = None) -> Router:
             raise HTTPError(409, "KeyExists", str(e)) from None
         return {"id": d["id"], "key": base64.b64encode(key).decode()}
 
+    def createkeys(req):
+        # bulk bootstrap: every key in ONE raft group-commit round
+        d = req.json()
+        try:
+            keys = node.create_keys([(e["id"], e["role"]) for e in d["entries"]])
+        except AuthError as e:
+            raise HTTPError(409, "KeyExists", str(e)) from None
+        return {"keys": {i: base64.b64encode(k).decode()
+                         for i, k in keys.items()}}
+
     def deletekey(req):
         try:
             node.delete_key(req.json()["id"])
@@ -60,6 +70,7 @@ def build_router(node: AuthNode, admin_secret: bytes | None = None) -> Router:
     if admin_secret is not None:
         admin.middleware.append(auth_middleware(admin_secret))
     admin.post("/admin/createkey", createkey)
+    admin.post("/admin/createkeys", createkeys)
     admin.post("/admin/deletekey", deletekey)
     admin.post("/admin/addcaps", addcaps)
 

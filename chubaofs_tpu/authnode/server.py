@@ -111,10 +111,32 @@ class AuthNode:
         self.sm = sm
 
     def _apply(self, **data):
+        # rides raft group commit: concurrent keystore admins coalesce into
+        # shared WAL-flush + replication rounds on AUTH_GROUP
         status, result = self.raft.propose(AUTH_GROUP, data).result(timeout=5.0)
         if status == "err":
             raise AuthError(result)
         return result
+
+    def _apply_batch(self, datas: list[dict], timeout: float = 5.0) -> list:
+        """Many keystore ops in ONE drained raft batch; each fails alone."""
+        out = []
+        for fut in self.raft.propose_batch(AUTH_GROUP, datas):
+            status, result = fut.result(timeout=timeout)
+            if status == "err":
+                raise AuthError(result)
+            out.append(result)
+        return out
+
+    def create_keys(self, entries: list[tuple[str, str]]) -> dict[str, bytes]:
+        """Bootstrap helper: mint several (id, role) keys in one raft commit
+        round (cluster bring-up creates client+service keys together)."""
+        keys = {eid: cryptoutil.gen_key() for eid, _ in entries}
+        self._apply_batch([
+            {"op": "create_key", "id": eid, "key": _b64(keys[eid]),
+             "role": role, "caps": []}
+            for eid, role in entries])
+        return keys
 
     # -- keystore admin ----------------------------------------------------------
 

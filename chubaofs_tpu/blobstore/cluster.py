@@ -42,8 +42,9 @@ class MiniCluster:
             node = BlobNode(node_id=n, disk_roots=roots)
             self.nodes[n] = node
             az = (n - 1) % azs
-            for disk_id in node.disks:
-                self.cm.register_disk(disk_id, node_id=n, az=az)
+            self.cm.register_disks([
+                {"disk_id": disk_id, "node_id": n, "az": az}
+                for disk_id in node.disks])
         self.proxy = Proxy(self.cm, data_dir=os.path.join(root, "proxy"))
         self.access = Access(self.cm, self.proxy, self.nodes, codec=self.codec)
         self.scheduler = Scheduler(self.cm, self.proxy, self.nodes, codec=self.codec)

@@ -193,6 +193,23 @@ class ClusterMgr:
             self._db.put(self._wal_key(self._seq), json.dumps([op, args]).encode())
         return out
 
+    def _apply_batch(self, ops: list[tuple[str, dict]]) -> list:
+        """Apply many ops with ONE durable kv write batch — the raft
+        group-commit analog at this store's WAL layer (lock held by caller).
+        Ops already applied before a mid-batch failure still reach the WAL."""
+        out, puts = [], []
+        try:
+            for op, args in ops:
+                out.append(getattr(self, "_op_" + op)(**args))
+                if self._db:
+                    self._seq += 1
+                    puts.append((self._wal_key(self._seq),
+                                 json.dumps([op, args]).encode()))
+        finally:
+            if self._db and puts:
+                self._db.write_batch(puts=puts)
+        return out
+
     def close(self):
         if self._db is not None:
             self._db.close()
@@ -217,6 +234,13 @@ class ClusterMgr:
 
     def register_disk(self, disk_id: int, node_id: int, az: int = 0, rack: str = "") -> None:
         self.apply("register_disk", {"disk_id": disk_id, "node_id": node_id, "az": az, "rack": rack})
+
+    def register_disks(self, specs: list[dict]) -> None:
+        """Register many disks in ONE batched WAL commit (cluster bring-up:
+        a node's whole disk set lands as a single kv write batch)."""
+        with self._lock:
+            self._apply_batch([
+                ("register_disk", {"az": 0, "rack": "", **s}) for s in specs])
 
     def _op_register_disk(self, disk_id: int, node_id: int, az: int, rack: str):
         if disk_id not in self.disks:

@@ -21,7 +21,8 @@ and stays a near-free no-op (one empty-dict lookup) until a test or the
 Each action takes optional suffixes: `@p` fires with probability p from a
 per-arming seeded RNG (deterministic given the call sequence), `*n` fires
 for the first n matching hits only, `#node` restricts to one node id.
-Example spec: `raft.send=drop@0.1;blobnode.get_shard=hang#2*5`.
+Example spec: `raft.send=drop@0.1;blobnode.get_shard=hang*5#2` (suffix
+order matters: `@prob`, then `*times`, then `#node`).
 """
 
 from __future__ import annotations

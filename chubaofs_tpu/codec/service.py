@@ -47,11 +47,23 @@ class _Job:
     kind: str  # "encode" | "matmul"
     n: int
     m: int
-    data: np.ndarray  # (rows, k) uint8
-    k: int
+    data: np.ndarray  # (rows, kb) uint8 — PRE-PADDED to the shape bucket
+    k: int  # true shard length (result is sliced back to it)
+    kb: int  # bucket_len(k), computed at submission
     future: Future = field(default_factory=Future)
     # matmul jobs carry their GF matrix (repair rows x survivors)
     mat: np.ndarray | None = None
+
+
+def _pad_to_bucket(data: np.ndarray, k: int, kb: int) -> np.ndarray:
+    """Pad (rows, k) up to (rows, kb) on the SUBMITTING thread — the drain
+    loop then only stacks, and padding cost parallelizes across callers
+    instead of serializing on the dispatcher."""
+    if k == kb:
+        return np.ascontiguousarray(data, np.uint8)
+    out = np.zeros((data.shape[0], kb), np.uint8)
+    out[:, :k] = data
+    return out
 
 
 class CodecService:
@@ -78,6 +90,9 @@ class CodecService:
         self._started = False
         self._closed = False
         self._lock = threading.Lock()
+        # dispatcher observability: how well jobs coalesce into device batches
+        # (same counter shape as MultiRaft.drain_stats for the raft drain)
+        self.stats = {"batches": 0, "jobs": 0, "max_batch": 0}
 
     def _ensure_started(self):
         with self._lock:
@@ -93,7 +108,9 @@ class CodecService:
         """data (n, k) uint8 -> Future[(n+m, k) uint8 full stripe]."""
         if data.shape[0] != n:
             raise ValueError(f"want {n} data rows, got {data.shape}")
-        job = _Job("encode", n, m, np.ascontiguousarray(data, np.uint8), data.shape[1])
+        k = data.shape[1]
+        kb = bucket_len(k)
+        job = _Job("encode", n, m, _pad_to_bucket(data, k, kb), k, kb)
         self._submit(job)
         return job.future
 
@@ -113,7 +130,10 @@ class CodecService:
         # match its parity
         data = np.array(data, np.uint8, order="C")
         mat = lrc_parity_matrix(t)
-        job = _Job("matmul", t.N, t.M + t.L, data, data.shape[1], mat=mat)
+        k = data.shape[1]
+        kb = bucket_len(k)
+        job = _Job("matmul", t.N, t.M + t.L, _pad_to_bucket(data, k, kb),
+                   k, kb, mat=mat)
         self._submit(job)
         out: Future = Future()
 
@@ -136,8 +156,11 @@ class CodecService:
             f: Future = Future()
             f.set_result(np.array(shards, copy=True))
             return f
-        survivors = np.ascontiguousarray(shards[np.asarray(present)], np.uint8)
-        job = _Job("matmul", n, m, survivors, shards.shape[1], mat=mat)
+        k = shards.shape[1]
+        kb = bucket_len(k)
+        survivors = _pad_to_bucket(
+            np.asarray(shards, np.uint8)[np.asarray(present)], k, kb)
+        job = _Job("matmul", n, m, survivors, k, kb, mat=mat)
         self._submit(job)
 
         out_future: Future = Future()
@@ -212,15 +235,16 @@ class CodecService:
                 return
             if not batch:
                 continue
-            # group by compatible shape signature
+            # group by compatible shape signature (kb was bucketed at
+            # submission; the drain loop never re-derives shapes)
             groups: dict[tuple, list[_Job]] = {}
             for j in batch:
                 if j.kind == "encode":
-                    sig = ("encode", j.n, j.m, bucket_len(j.k))
+                    sig = ("encode", j.n, j.m, j.kb)
                 else:
                     # matrices are tiny (<= 36x36): key by CONTENT so only jobs
                     # with the identical repair matrix share a batch
-                    sig = ("matmul", j.mat.tobytes(), j.data.shape[0], bucket_len(j.k))
+                    sig = ("matmul", j.mat.tobytes(), j.data.shape[0], j.kb)
                 groups.setdefault(sig, []).append(j)
             for sig, jobs in groups.items():
                 try:
@@ -231,10 +255,11 @@ class CodecService:
                             j.future.set_exception(e)
 
     def _run_group(self, sig: tuple, jobs: list[_Job]):
-        kb = sig[-1]
-        stack = np.zeros((len(jobs), jobs[0].data.shape[0], kb), np.uint8)
-        for i, j in enumerate(jobs):
-            stack[i, :, : j.k] = j.data
+        # jobs arrive pre-padded to the bucket: stacking is the whole job here
+        stack = np.stack([j.data for j in jobs])
+        self.stats["batches"] += 1
+        self.stats["jobs"] += len(jobs)
+        self.stats["max_batch"] = max(self.stats["max_batch"], len(jobs))
         # both paths go through the host-boundary grouped entry: batches of
         # stripes are viewed (free numpy reshape) as MXU-row-filling groups
         # before they ever reach the device (rs.gf_matmul_hostbatch) — or,

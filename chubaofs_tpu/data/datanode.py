@@ -36,7 +36,7 @@ from chubaofs_tpu.proto.packet import (
     OP_WRITE, Packet, RES_DISK_ERR, RES_ERR, RES_NOT_EXIST, RES_NOT_LEADER,
     RES_OK, is_tiny_extent,
 )
-from chubaofs_tpu.raft.server import MultiRaft, StateMachine
+from chubaofs_tpu.raft.server import MultiRaft, NotLeaderError, StateMachine
 from chubaofs_tpu.storage.extent_store import (
     ExtentNotFound, ExtentStore, MIN_NORMAL_EXTENT_ID, StorageError,
 )
@@ -339,7 +339,14 @@ class DataNode:
         if not dp.is_raft_leader:
             return pkt.reply(RES_NOT_LEADER,
                              arg={"leader": dp.raft.leader_of(dp.pid)})
-        fut = dp.raft.propose(dp.pid, ("rw", pkt.extent_id, pkt.extent_offset, pkt.data))
+        # concurrent handler threads coalesce in the group-commit pending
+        # queue: one WAL flush + one AppendEntries round per drained batch,
+        # not per packet (the partition_op_by_raft.go hot path)
+        try:
+            fut = dp.raft.propose(
+                dp.pid, ("rw", pkt.extent_id, pkt.extent_offset, pkt.data))
+        except NotLeaderError as e:  # deposed between the gate and the propose
+            return pkt.reply(RES_NOT_LEADER, arg={"leader": e.leader})
         status, detail = fut.result(timeout=10)
         if status != "ok":
             return pkt.reply(RES_ERR, arg={"error": detail})

@@ -499,10 +499,24 @@ class Master:
         self._dead_drained: set[int] = set()
 
     def _apply(self, op: str, **args):
+        # rides raft group commit: concurrent admin/heartbeat handler threads
+        # coalesce into shared WAL-flush + replication rounds on GroupID=1
         res = self.raft.propose(MASTER_GROUP, (op, args)).result(timeout=5)
         if res[0] == "err":
             raise MasterError(res[1])
         return res[1]
+
+    def _apply_batch(self, ops: list[tuple[str, dict]], timeout: float = 5.0) -> list:
+        """Propose many master ops as ONE drained raft batch (one WAL flush,
+        one replication fan-out); results FIFO, each op failing alone."""
+        futs = self.raft.propose_batch(MASTER_GROUP, [(op, args) for op, args in ops])
+        out = []
+        for fut in futs:
+            res = fut.result(timeout=timeout)
+            if res[0] == "err":
+                raise MasterError(res[1])
+            out.append(res[1])
+        return out
 
     @property
     def is_leader(self) -> bool:
@@ -681,8 +695,8 @@ class Master:
     def create_volume(self, name: str, owner: str = "", capacity: int = 1 << 40,
                       cold: bool = False, data_partitions: int = 3,
                       follower_read: bool = False) -> VolumeView:
-        vol_id = self._apply("alloc_id")
-        pid = self._apply("alloc_id")
+        # both ids in one drained raft batch: one commit round, not two
+        vol_id, pid = self._apply_batch([("alloc_id", {}), ("alloc_id", {})])
         peers = self._pick_meta_peers()
         vol = self._apply(
             "create_volume", name=name, owner=owner, capacity=capacity, cold=cold,

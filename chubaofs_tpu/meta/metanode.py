@@ -61,9 +61,9 @@ class MetaNode:
 
     # -- write ops: through raft ---------------------------------------------
 
-    def submit(self, partition_id: int, op: str, **args) -> Future:
-        """Propose one fsm op; future resolves to the op result or raises."""
-        fut = self.raft.propose(partition_id, (op, dict(args)))
+    @staticmethod
+    def _chain_result(fut: Future) -> Future:
+        """Map a raft apply-result future onto the op-result/OpError shape."""
         out: Future = Future()
 
         def _done(f: Future):
@@ -78,6 +78,21 @@ class MetaNode:
 
         fut.add_done_callback(_done)
         return out
+
+    def submit(self, partition_id: int, op: str, **args) -> Future:
+        """Propose one fsm op; future resolves to the op result or raises.
+        Rides raft group commit: concurrent submits against one partition
+        coalesce into shared WAL-flush + replication rounds."""
+        return self._chain_result(self.raft.propose(partition_id, (op, dict(args))))
+
+    def submit_batch(self, partition_id: int, ops: list[tuple[str, dict]]) -> list[Future]:
+        """Propose many fsm ops in one drained raft batch (one WAL flush, one
+        AppendEntries fan-out). FIFO apply order; each op fails or resolves
+        independently — errors are values through consensus, so one EEXIST in
+        a batch never poisons its neighbors."""
+        futs = self.raft.propose_batch(
+            partition_id, [(op, dict(args)) for op, args in ops])
+        return [self._chain_result(f) for f in futs]
 
     def submit_sync(self, partition_id: int, op: str, timeout: float = 5.0, **args):
         return self.submit(partition_id, op, **args).result(timeout)
@@ -199,7 +214,11 @@ class MetaNode:
             if not self.raft.is_leader(pid):
                 continue
             try:
-                drained = self.submit_sync(pid, "drain_freelist")
+                # both peeks ride ONE drained raft batch (group commit):
+                # half the consensus rounds per partition sweep
+                drained_fut, extents_fut = self.submit_batch(
+                    pid, [("drain_freelist", {}), ("drain_del_extents", {})])
+                drained = drained_fut.result(5.0)
             except (NotLeaderError, OpError):
                 continue
             done = []
@@ -218,7 +237,7 @@ class MetaNode:
                 purged += len(done)
 
             try:
-                entries = self.submit_sync(pid, "drain_del_extents")
+                entries = extents_fut.result(5.0)
             except (NotLeaderError, OpError):
                 continue
             acked = []

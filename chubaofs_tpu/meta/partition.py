@@ -159,6 +159,12 @@ class MetaPartitionSM(StateMachine):
     UNIQ_WINDOW = 128
 
     def apply(self, data, index: int):
+        """One fsm op. Under raft group commit, entries arrive in drained
+        BATCHES (one WAL flush + replication round for up to max_batch
+        submits), but each entry still applies alone in log order: errors are
+        values through consensus, so a failing op (EEXIST, EDQUOT, ...) never
+        poisons the rest of its drained batch, and the proposer-stamped _now/
+        _uniq semantics are untouched by who shared its commit round."""
         op, args = data
         uniq = args.get("_uniq")  # never mutate args: the tuple is shared
         if "_now" in args:

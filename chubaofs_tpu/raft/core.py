@@ -11,6 +11,14 @@ in-process fakes (SURVEY §4).
 
 Log model: 1-based indexes; entries list holds (term, payload) pairs starting at
 `offset + 1` (offset = index of the last snapshot-compacted entry).
+
+Group commit (the reference drain loop, raft.go:283-311): client proposals
+accumulate in `pending` and `drain_proposals()` moves up to `max_batch` of
+them into the log in ONE append pass followed by ONE AppendEntries fan-out —
+so N concurrent proposers cost one replication round, not N. Replication is
+pipelined per follower: `_send_append` advances next_index optimistically
+(batch i+1 ships without waiting for ack i) under a bounded in-flight window;
+NACKs and heartbeat probes rewind next_index, so message loss self-heals.
 """
 
 from __future__ import annotations
@@ -24,6 +32,11 @@ ROLE_LEADER = "leader"
 
 ELECTION_TICKS = 10  # randomized per-node in [E, 2E)
 HEARTBEAT_TICKS = 2
+# group-commit drain width (reference parity: raft.go:283-311 drains up to 64
+# pending proposals into one log-append/replication round) and the per-follower
+# pipelined-replication window (entries in flight past the last verified match)
+MAX_BATCH = 64
+MAX_INFLIGHT = 4 * MAX_BATCH
 
 
 class NotLeaderError(Exception):
@@ -36,6 +49,9 @@ class NotLeaderError(Exception):
 class Entry:
     term: int
     data: object  # opaque command; None for leader no-op barriers
+    # cached codec-encoded payload for the WAL (filled by the first persist;
+    # in-proc replicas share the Entry, so one encode serves all three logs)
+    wal_hex: str | None = None
 
 
 @dataclass
@@ -67,11 +83,16 @@ class Msg:
 
 
 class RaftCore:
-    def __init__(self, group: int, node_id: int, peers: list[int], rng: random.Random | None = None):
+    def __init__(self, group: int, node_id: int, peers: list[int],
+                 rng: random.Random | None = None, max_batch: int = MAX_BATCH):
         self.group = group
         self.id = node_id
         self.peers = [p for p in peers if p != node_id]
         self.rng = rng or random.Random(node_id * 7919 + group)
+        self.max_batch = max_batch
+        # group commit: queued client proposals awaiting a drain round; the
+        # server owns the matching futures (FIFO, same enqueue order)
+        self.pending: list = []
 
         # persistent state
         self.term = 0
@@ -81,6 +102,10 @@ class RaftCore:
         self.entries: list[Entry] = []
 
         # volatile
+        # lowest index whose entry was overwritten since the last WAL flush:
+        # the server must re-persist from here, or recovery replays the
+        # stale-term suffix a conflicting append truncated in memory
+        self.log_rewind: int | None = None
         self.role = ROLE_FOLLOWER
         self.leader: int | None = None
         self.commit = 0
@@ -165,16 +190,46 @@ class RaftCore:
         return True
 
     def propose(self, data) -> int:
+        return self.propose_batch([data])[-1]
+
+    def propose_batch(self, datas: list) -> list[int]:
+        """Queue + drain in one call: the whole batch lands in the log as one
+        append pass and one AppendEntries fan-out (multiple drain rounds only
+        past max_batch). Returns the assigned indexes, FIFO."""
         if self.role != ROLE_LEADER:
             raise NotLeaderError(self.leader)
-        self.entries.append(Entry(self.term, data))
-        index = self.last_index
-        self.match_index[self.id] = index
+        self.pending.extend(datas)
+        out: list[int] = []
+        while self.pending:
+            out += self.drain_proposals()
+        return out[-len(datas):]
+
+    def queue_proposal(self, data) -> None:
+        """Enqueue one proposal for the next drain round (group commit)."""
+        if self.role != ROLE_LEADER:
+            raise NotLeaderError(self.leader)
+        self.pending.append(data)
+
+    def drain_proposals(self) -> list[int]:
+        """Move up to max_batch pending proposals into the log: ONE append
+        pass, ONE replication fan-out (the raft.go:283-311 drain loop analog).
+        Raises NotLeaderError — pending intact for the caller to fail — when
+        leadership was lost between enqueue and drain."""
+        if self.role != ROLE_LEADER:
+            raise NotLeaderError(self.leader)
+        batch = self.pending[: self.max_batch]
+        if not batch:
+            return []
+        del self.pending[: len(batch)]
+        first = self.last_index + 1
+        for data in batch:
+            self.entries.append(Entry(self.term, data))
+        self.match_index[self.id] = self.last_index
         if not self.peers:  # single-node group commits immediately
             self._advance_commit()
         else:
             self._broadcast_append()
-        return index
+        return list(range(first, self.last_index + 1))
 
     # -- membership (single-server change: one add/remove per entry keeps any
     # two quorums overlapping, the standard safe reconfiguration) -------------
@@ -301,7 +356,17 @@ class RaftCore:
             self._send_snapshot(peer)
             return
         prev = next_i - 1
-        ents = [self.entry_at(i) for i in range(next_i, self.last_index + 1)]
+        # pipelined replication: ship at most max_batch entries per message
+        # and advance next_index OPTIMISTICALLY, so batch i+1 goes out without
+        # waiting for ack i. The window bounds entries in flight past the last
+        # verified match; when it is full (or next_i is already past the tail)
+        # this degrades to an empty probe carrying prev/commit — the probe's
+        # ACK advances match, its NACK rewinds next_index, so both lost
+        # appends and lost acks self-heal on the heartbeat cadence.
+        ents: list[Entry] = []
+        if prev - self.match_index.get(peer, 0) < MAX_INFLIGHT:
+            end = min(self.last_index, next_i + self.max_batch - 1)
+            ents = [self.entry_at(i) for i in range(next_i, end + 1)]
         self._send(
             type="append",
             dst=peer,
@@ -311,6 +376,8 @@ class RaftCore:
             entries=ents,
             commit=self.commit,
         )
+        if ents:
+            self.next_index[peer] = next_i + len(ents)
 
     def _send_snapshot(self, peer: int):
         if self.snapshot_fn is None:
@@ -336,14 +403,18 @@ class RaftCore:
                 match_index=min(self.last_index, max(self.offset, m.prev_index - 1)),
             )
             return
-        # append, truncating conflicts
+        # append, truncating conflicts IN PLACE — the common fresh-tail case
+        # must not copy the whole log per entry (O(batch x log) per append)
         for i, ent in enumerate(m.entries):
             idx = m.prev_index + 1 + i
             if idx <= self.offset:
                 continue  # already compacted into a snapshot
-            if idx <= self.last_index and self.term_at(idx) == ent.term:
-                continue
-            self.entries = self.entries[: idx - self.offset - 1]
+            if idx <= self.last_index:
+                if self.term_at(idx) == ent.term:
+                    continue
+                del self.entries[idx - self.offset - 1:]
+                if self.log_rewind is None or idx < self.log_rewind:
+                    self.log_rewind = idx
             self.entries.append(ent)
         if m.commit > self.commit:
             self.commit = min(m.commit, self.last_index)
@@ -361,11 +432,20 @@ class RaftCore:
             return
         if m.success:
             self.match_index[m.src] = max(self.match_index.get(m.src, 0), m.match_index)
-            self.next_index[m.src] = self.match_index[m.src] + 1
+            # never rewind a pipelined next_index on an (older) ack
+            self.next_index[m.src] = max(
+                self.next_index.get(m.src, 0), self.match_index[m.src] + 1)
             self._advance_commit()
+            if self.next_index[m.src] <= self.last_index:
+                # window freed / next chunk of a laggard catch-up
+                self._send_append(m.src)
         else:
-            hint = m.match_index if m.match_index > 0 else self.next_index.get(m.src, 2) - 2
-            self.next_index[m.src] = max(1, min(hint + 1, self.last_index + 1))
+            # prefix mismatch: the follower's match_index hint is always
+            # genuine here (a stale-term NACK carries a higher term, which
+            # dethroned us in step() before reaching this branch), so jump
+            # next_index straight to it — a next_index-relative backoff would
+            # fight the pipelined optimistic advance and never converge
+            self.next_index[m.src] = max(1, min(m.match_index + 1, self.last_index + 1))
             self._send_append(m.src)
 
     def _advance_commit(self):

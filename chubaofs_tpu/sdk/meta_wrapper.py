@@ -338,8 +338,12 @@ class MetaWrapper:
         try:
             return bool(self._on_partition(
                 mp, lambda n: n.read_dir(mp.partition_id, ino)))
-        except OpError:
-            return False
+        except OpError as e:
+            if e.code == "ENOENT":
+                return False  # inode already gone: nothing to orphan
+            # a transient failure must NOT read as "empty" — rename-over
+            # would displace a non-empty dir and orphan its subtree
+            raise
 
     # -- directory quotas (master_quota_manager + metanode quota analog) --------
 

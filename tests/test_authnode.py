@@ -114,6 +114,23 @@ def test_duplicate_key_error_does_not_poison_raft(auth_cluster):
         an.delete_key("never-existed")
 
 
+def test_bulk_create_keys_one_commit_round(auth_cluster):
+    """create_keys mints several keys through ONE drained raft batch; all
+    land, all replicate, and a duplicate in a later batch fails alone."""
+    from chubaofs_tpu.authnode.server import AuthError
+
+    an = auth_cluster.authnode()
+    keys = an.create_keys([("bulk-svc", "service"), ("bulk-a", "client"),
+                           ("bulk-b", "client")])
+    assert set(keys) == {"bulk-svc", "bulk-a", "bulk-b"}
+    assert an.sm.get("bulk-svc")["role"] == "service"
+    auth_cluster.settle(lambda: all(
+        "bulk-b" in sm.keys for sm in auth_cluster.keystore_sms.values()))
+    with pytest.raises(AuthError):
+        an.create_keys([("bulk-a", "client")])  # dup fails as a value
+    an.create_key("bulk-c", "client")  # pump healthy after the error
+
+
 def test_caps_grant_scoped_to_service(auth_cluster):
     an = auth_cluster.authnode()
     skey = an.create_key("svcA", "service")
@@ -144,6 +161,10 @@ def test_authnode_http_api(auth_cluster):
                          {"id": "httpcli", "role": "client",
                           "caps": ["httpsvc:*"]})
         cli_key = base64.b64decode(out["key"])
+        out = admin.post("/admin/createkeys", {"entries": [
+            {"id": "hbulk1", "role": "client"},
+            {"id": "hbulk2", "role": "client"}]})
+        assert set(out["keys"]) == {"hbulk1", "hbulk2"}
         # unauthenticated admin rejected
         noauth = RPCClient([srv.addr])
         with pytest.raises(HTTPError) as ei:

@@ -259,5 +259,10 @@ def test_codec_service_concurrent_mixed_load():
             t.join(timeout=60)
         assert not any(t.is_alive() for t in threads), "worker deadlocked"
         assert not errors, errors
+        # dispatcher stats: every job accounted, and the racing mixed load
+        # must have coalesced at least one multi-job device batch
+        assert svc.stats["jobs"] == 8 * 6 * 2, svc.stats
+        assert svc.stats["batches"] <= svc.stats["jobs"]
+        assert svc.stats["max_batch"] >= 1
     finally:
         svc.close()

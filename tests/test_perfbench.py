@@ -12,9 +12,22 @@ import sys
 
 import pytest
 
-pytestmark = pytest.mark.slow
+
+def test_raft_commit_microbench_floor(tmp_path):
+    """Tier-1 batching gate: the in-proc raft-commit microbench (no
+    subprocess cluster — seconds, not minutes) with 10x-slack floors, so a
+    group-commit regression fails fast. Floors are against tiny-size rates
+    (measured ~216 1p / ~1530 8x8 on the 2-vCPU dev host)."""
+    from chubaofs_tpu.tools.perfbench import bench_raft_commit
+
+    out = bench_raft_commit(str(tmp_path), n_ops=120)
+    assert out["raft_commit_ops_1p"] > 20, out
+    assert out["raft_commit_ops_8x8"] > 120, out
+    # group commit must actually form multi-entry drained batches
+    assert out["raft_commit_batch_8p"] > 1.0, out
 
 
+@pytest.mark.slow
 def test_perfbench_tool_runs_and_gates(tmp_path):
     # own session so a timeout kill reaps the 7 daemon GRANDCHILDREN too —
     # subprocess.run's kill stops only the direct child, orphaning the
@@ -42,3 +55,11 @@ def test_perfbench_tool_runs_and_gates(tmp_path):
     assert cfg["seq_write_mbps"] > 5, cfg
     assert cfg["seq_read_mbps"] > 15, cfg
     assert cfg["smallfile_write_tps"] > 6, cfg
+    # raft group-commit microbench floors (measured ~216/169/1530 at this
+    # tiny size on the dev host — the 64p config is thread-spawn dominated
+    # at 1 op/proposer; full-size numbers live in PERF.md)
+    assert cfg["raft_commit_ops_1p"] > 20, cfg
+    assert cfg["raft_commit_ops_64p"] > 15, cfg
+    assert cfg["raft_commit_ops_8x8"] > 120, cfg
+    # batching must actually form batches at 64 concurrent proposers
+    assert cfg["raft_commit_batch_64p"] > 1.0, cfg

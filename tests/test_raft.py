@@ -1,5 +1,7 @@
 """Raft consensus: elections, replication, failures, snapshots, multi-group."""
 
+import time
+
 import pytest
 
 from chubaofs_tpu.raft import MultiRaft, InProcNet, NotLeaderError, StateMachine
@@ -192,6 +194,122 @@ def test_leader_change_callback():
     assert run_until(net, lambda: leader_id(nodes) is not None)
     lead = leader_id(nodes)
     assert sms[lead].leader_changes[-1] == lead
+
+
+# -- group commit: propose_batch ordering + atomicity ---------------------------
+
+
+def test_propose_batch_fifo_across_singles_and_batches():
+    """Interleaved propose() and propose_batch() apply in exact submission
+    order on EVERY replica — group commit coalesces rounds, never reorders."""
+    net, nodes, sms = make_cluster(3)
+    assert run_until(net, lambda: leader_id(nodes) is not None)
+    lead = nodes[leader_id(nodes)]
+    futs, expected = [], []
+    for i in range(3):
+        batch = [("set", f"b{i}_{j}", j) for j in range(5)]
+        futs += lead.propose_batch(1, batch)
+        expected += [d[1] for d in batch]
+        futs.append(lead.propose(1, ("set", f"s{i}", i)))
+        expected.append(f"s{i}")
+    assert run_until(net, lambda: all(f.done() for f in futs), max_ticks=600)
+    for f in futs:
+        assert f.exception() is None
+    assert run_until(
+        net, lambda: all(len(s.applied) >= len(expected) for s in sms.values()),
+        max_ticks=600)
+    for s in sms.values():
+        keys = [d[1] for _, d in s.applied]
+        assert keys == expected, "apply order diverged from submission order"
+
+
+def test_propose_batch_error_fails_only_its_own_future():
+    """Errors are VALUES through consensus: one EEXIST inside a drained
+    batch fails exactly its own future; neighbors commit untouched."""
+    import stat
+
+    from chubaofs_tpu.meta.metanode import MetaNode, OpError
+
+    net = InProcNet()
+    node = MultiRaft(1, net)
+    mn = MetaNode(1, node)
+    mn.create_partition(7, 1, 1 << 20, [1])
+    assert run_until(net, lambda: node.is_leader(7))
+    mode = stat.S_IFREG | 0o644
+    futs = mn.submit_batch(7, [
+        ("create_inode_dentry", {"parent": 1, "name": "a", "mode": mode}),
+        ("create_inode_dentry", {"parent": 1, "name": "a", "mode": mode}),
+        ("create_inode_dentry", {"parent": 1, "name": "b", "mode": mode}),
+    ])
+    assert futs[0].result(timeout=5).ino > 1
+    with pytest.raises(OpError) as ei:
+        futs[1].result(timeout=5)
+    assert ei.value.code == "EEXIST"
+    assert futs[2].result(timeout=5).ino > 1
+    assert set(mn.partitions[7].children[1]) == {"a", "b"}
+
+
+def test_propose_batch_stale_term_fails_each_stranded_future():
+    """A batch stranded on a deposed leader: every entry overwritten by the
+    new term fails its own future with NotLeaderError; the new leader's
+    proposals are untouched."""
+    net, nodes, sms = make_cluster(3)
+    assert run_until(net, lambda: leader_id(nodes) is not None)
+    old_id = leader_id(nodes)
+    old = nodes[old_id]
+    net.isolate(old_id)
+    stranded = old.propose_batch(1, [("set", f"lost{i}", i) for i in range(3)])
+    others = [i for i in nodes if i != old_id]
+    assert run_until(
+        net, lambda: any(nodes[i].is_leader(1) for i in others), max_ticks=600)
+    new = nodes[next(i for i in others if nodes[i].is_leader(1))]
+    # enough new-term entries to cover every stranded index
+    wins = [new.propose(1, ("set", f"win{i}", i)) for i in range(5)]
+    assert run_until(net, lambda: all(f.done() for f in wins), max_ticks=600)
+    net.heal()
+    assert run_until(
+        net, lambda: all(f.done() for f in stranded), max_ticks=900)
+    for f in stranded:
+        assert isinstance(f.exception(), NotLeaderError)
+    for f in wins:
+        assert f.exception() is None
+    assert run_until(
+        net, lambda: all(s.kv.get("win4") == 4 for s in sms.values()),
+        max_ticks=600)
+    assert all("lost0" not in s.kv for s in sms.values())
+
+
+def test_wal_persists_conflict_truncated_rewrites(tmp_path):
+    """A deposed leader's WAL holds a stale unreplicated tail; the new
+    term's entries overwrite it in memory — the rewritten span must reach
+    the WAL too, or a crash-restart replays the stale suffix."""
+    net, nodes, sms = make_cluster(3, wal_root=str(tmp_path))
+    assert run_until(net, lambda: leader_id(nodes) is not None)
+    old_id = leader_id(nodes)
+    fut = nodes[old_id].propose(1, ("set", "base", 0))
+    assert run_until(net, lambda: fut.done())
+
+    net.isolate(old_id)
+    nodes[old_id].propose_batch(1, [("set", f"stale{i}", i) for i in range(3)])
+    time.sleep(0.2)  # pump drains + persists the doomed tail
+    others = [i for i in nodes if i != old_id]
+    assert run_until(
+        net, lambda: any(nodes[i].is_leader(1) for i in others), max_ticks=600)
+    new = nodes[next(i for i in others if nodes[i].is_leader(1))]
+    wins = [new.propose(1, ("set", f"win{i}", i)) for i in range(4)]
+    assert run_until(net, lambda: all(f.done() for f in wins), max_ticks=600)
+
+    net.heal()
+    assert run_until(
+        net, lambda: sms[old_id].kv.get("win3") == 3, max_ticks=900)
+    # crash-restart the deposed node from its WAL alone
+    sm2 = KvSM()
+    n2 = MultiRaft(old_id, InProcNet(), wal_dir=str(tmp_path / f"n{old_id}"))
+    n2.create_group(1, [1, 2, 3], sm2)
+    assert "stale0" not in sm2.kv, "recovery replayed a truncated stale tail"
+    assert sm2.kv.get("base") == 0
+    assert all(sm2.kv.get(f"win{i}") == i
+               for i in range(4) if f"win{i}" in sms[old_id].kv)
 
 
 # -- merged cross-group heartbeats (tiglabs raft README:18) ---------------------
