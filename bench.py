@@ -254,6 +254,22 @@ def main() -> None:
     )
     log(f"EC(20,4)+L2 16MiB encode: {cfg['ec20p4l2_encode_16mib_gbps']} GB/s")
 
+    # /metrics snapshot next to the BENCH_*.json line: the bench figures as
+    # gauges plus whatever role registries (codec, raft, ...) this process
+    # exercised — perf rounds carry counters alongside throughput lines
+    try:
+        from chubaofs_tpu.utils import exporter
+
+        breg = exporter.registry("bench")
+        for k, v in cfg.items():
+            if isinstance(v, (int, float)):
+                breg.gauge(k).set(v)
+        dump_path = os.environ.get("CFS_METRICS_DUMP", "BENCH_metrics.prom")
+        exporter.dump(dump_path)
+        log(f"metrics snapshot -> {dump_path}")
+    except Exception as e:  # a dump failure must never kill the bench line
+        log(f"metrics snapshot failed: {type(e).__name__}: {e}")
+
     print(
         json.dumps(
             {

@@ -37,8 +37,9 @@ from chubaofs_tpu.blobstore.clustermgr import ClusterMgr, VolumeInfo
 from chubaofs_tpu.blobstore.proxy import Proxy
 from chubaofs_tpu.codec.codemode import CodeMode, get_tactic
 from chubaofs_tpu.codec.service import CodecService, default_service
+from chubaofs_tpu.utils.auditlog import record_slow_op
 from chubaofs_tpu.utils.breaker import CircuitBreaker
-from chubaofs_tpu.utils.exporter import default_registry
+from chubaofs_tpu.utils.exporter import registry
 
 MAX_BLOB_SIZE = 4 * 1024 * 1024
 
@@ -222,8 +223,8 @@ class Access:
     def punish_disk(self, disk_id: int, reason: str = "") -> None:
         with self._punish_lock:
             self._punished[disk_id] = time.monotonic() + self.punish_secs
-        default_registry().counter(
-            "access_disk_punish", {"reason": reason or "error"}).add()
+        registry("access").counter(
+            "disk_punish", {"reason": reason or "error"}).add()
 
     def clear_punishments(self) -> None:
         """Drop every active punish window (ops lever): once an AZ/host
@@ -252,13 +253,23 @@ class Access:
         from chubaofs_tpu.blobstore import trace
 
         if self.qos is not None and not self.qos.wait("put", len(data), timeout=self.qos_timeout):
-            default_registry().counter("access_qos_reject", {"op": "put"}).add()
+            registry("access").counter("qos_reject", {"op": "put"}).add()
             raise AccessError("put bandwidth limit exceeded")
-        with trace.child_of(trace.current_span(), "access.put") as span:
+        with trace.child_of(trace.current_span(), "access.put") as span, \
+                registry("access").tp("put"):
             span.set_tag("size", len(data))
-            loc = self._put(data, code_mode)
-            span.append_track_log("access")
-            return loc
+            err: Exception | None = None
+            try:
+                loc = self._put(data, code_mode)
+                return loc
+            except Exception as e:
+                err = e
+                raise
+            finally:
+                span.append_track_log("access", err=err)
+                record_slow_op("access", "put",
+                               time.perf_counter() - span.start, span=span,
+                               err=type(err).__name__ if err else "")
 
     def _put(self, data: bytes, code_mode: CodeMode | int | None = None) -> Location:
         if not data:
@@ -278,8 +289,14 @@ class Access:
         futures = []
         metas = []
         t = get_tactic(mode)
+        from chubaofs_tpu.blobstore import trace
+
+        span = trace.current_span()
         for i, blob in enumerate(blobs):
+            t_alloc = time.perf_counter()
             vol = self._alloc_breaker.call(self.proxy.alloc_volume, mode)
+            if span is not None:
+                span.append_track_log("proxy", start=t_alloc)
             shard_len = t.shard_size(len(blob))
             mat = np.zeros((t.N, shard_len), np.uint8)
             flat = mat.reshape(-1)
@@ -289,7 +306,10 @@ class Access:
             metas.append((first_bid + i, vol, len(blob)))
 
         for fut, (bid, vol, size) in zip(futures, metas):
+            t_enc = time.perf_counter()
             stripe = fut.result()  # (total, shard_len), locals included
+            if span is not None:
+                span.append_track_log("codec", start=t_enc)
             try:
                 self._write_stripe(t, vol, bid, stripe)
             except VolumeFullError:
@@ -304,8 +324,14 @@ class Access:
         return loc
 
     def _write_stripe(self, t, vol: VolumeInfo, bid: int, stripe: np.ndarray):
+        from chubaofs_tpu.blobstore import trace
         from chubaofs_tpu.blobstore.blobnode import ChunkFull
 
+        # the stripe-write fan-out is the blobnode hop as the gateway sees
+        # it; one track entry covers the whole shard fan-out (stream_put.go
+        # logs the same aggregate)
+        span = trace.current_span()
+        t_hop = time.perf_counter()
         deadline = time.monotonic() + self.write_deadline
         started = [False] * t.total
 
@@ -349,6 +375,8 @@ class Access:
                 if started[idx]:
                     self.punish_disk(vol.units[idx].disk_id, "timeout")
                 results.append(TimeoutError("stripe write deadline"))
+        if span is not None:
+            span.append_track_log("blobnode", start=t_hop)
         ok = {i for i, r in zip(range(t.total), results) if r is None}
         failed = sorted(set(range(t.total)) - ok)
         # quorum counts global-stripe shards only (stream_put.go:226,362:
@@ -400,12 +428,21 @@ class Access:
             # charge the real read size: a default full-object get is loc.size
             want = size if size is not None else max(0, loc.size - offset)
             if not self.qos.wait("get", max(1, want), timeout=self.qos_timeout):
-                default_registry().counter("access_qos_reject", {"op": "get"}).add()
+                registry("access").counter("qos_reject", {"op": "get"}).add()
                 raise AccessError("get bandwidth limit exceeded")
-        with trace.child_of(trace.current_span(), "access.get") as span:
-            data = self._get(loc, offset, size)
-            span.append_track_log("access")
-            return data
+        with trace.child_of(trace.current_span(), "access.get") as span, \
+                registry("access").tp("get"):
+            err: Exception | None = None
+            try:
+                return self._get(loc, offset, size)
+            except Exception as e:
+                err = e
+                raise
+            finally:
+                span.append_track_log("access", err=err)
+                record_slow_op("access", "get",
+                               time.perf_counter() - span.start, span=span,
+                               err=type(err).__name__ if err else "")
 
     def _get(self, loc: Location | str, offset: int = 0, size: int | None = None) -> bytes:
         if isinstance(loc, str):
@@ -449,6 +486,10 @@ class Access:
         # read_deadline (wedged node/disk) is treated as missing and the
         # degraded path reconstructs around it — the stall is bounded even
         # when the node never errors (stream_get races laggards the same way)
+        from chubaofs_tpu.blobstore import trace
+
+        span = trace.current_span()
+        t_hop = time.perf_counter()
         idxs = list(range(first_shard, last_shard + 1))
         futs = [self._read_pool.submit(read_one, i) for i in idxs]
         deadline = time.monotonic() + self.read_deadline
@@ -460,6 +501,8 @@ class Access:
             except FutureTimeout:
                 pieces.append(None)
                 slow.add(i)
+        if span is not None:
+            span.append_track_log("blobnode", start=t_hop)
         if all(p is not None for p in pieces):
             return b"".join(pieces)
         for f in futs:  # queued laggards must not hold pool workers

@@ -424,7 +424,8 @@ class BlobNode:
     clustermgr maps vuid -> (node, disk, chunk).
     """
 
-    def __init__(self, node_id: int, disk_roots: list[str]):
+    def __init__(self, node_id: int, disk_roots: list[str],
+                 iostat: bool = False):
         self.node_id = node_id
         self.disks: dict[int, Disk] = {}
         for i, root in enumerate(disk_roots):
@@ -432,6 +433,17 @@ class BlobNode:
             self.disks[d.disk_id] = d
         self._chunk_of_vuid: dict[int, tuple[int, str]] = {}
         self._lock = threading.Lock()
+        # shard-IO observability: per-node TP metrics in the blobnode role
+        # registry; optionally the mmap'd iostat block node-side viewers read
+        # (common/iostat) — off by default so test fleets don't litter shm
+        from chubaofs_tpu.utils.exporter import registry as _registry
+
+        self._reg = _registry("blobnode")
+        self._iostat = None
+        if iostat:
+            from chubaofs_tpu.blobstore.iostat import IOStat
+
+            self._iostat = IOStat(f"blobnode-{node_id}")
         # recover vuid->chunk mapping from chunk names ("vuid-<id>")
         for d in self.disks.values():
             for cid in d.chunks:
@@ -463,19 +475,45 @@ class BlobNode:
     # -- shard API ----------------------------------------------------------
 
     def put_shard(self, vuid: int, bid: int, payload: bytes) -> None:
-        chaos.failpoint("blobnode.put_shard", node=self.node_id)
-        # corrupt-on-write models a bad controller: the framing CRCs the
-        # already-flipped bytes, so only a later stripe-level repair catches it
-        payload = chaos.corrupt_bytes("blobnode.put_shard.payload", payload,
-                                      node=self.node_id)
-        self._chunk(vuid).put(bid, vuid, payload)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        if self._iostat is not None:
+            self._iostat.write_begin()
+        try:
+            with self._reg.tp("shard_put"):
+                chaos.failpoint("blobnode.put_shard", node=self.node_id)
+                # corrupt-on-write models a bad controller: the framing CRCs
+                # the already-flipped bytes, so only a later stripe-level
+                # repair catches it
+                payload = chaos.corrupt_bytes("blobnode.put_shard.payload",
+                                              payload, node=self.node_id)
+                self._chunk(vuid).put(bid, vuid, payload)
+            self._reg.counter("shard_put_bytes_total").add(len(payload))
+        finally:
+            if self._iostat is not None:
+                self._iostat.write_done(
+                    len(payload), int((_time.perf_counter() - t0) * 1e6))
 
     def get_shard(self, vuid: int, bid: int, offset: int = 0, size: int | None = None) -> bytes:
-        chaos.failpoint("blobnode.get_shard", node=self.node_id)
-        data = self._chunk(vuid).get(bid, offset, size)
-        # corrupt-on-read models wire/DMA corruption past the CRC framing
-        return chaos.corrupt_bytes("blobnode.get_shard.data", data,
-                                   node=self.node_id)
+        import time as _time
+
+        t0 = _time.perf_counter()
+        data = b""
+        if self._iostat is not None:
+            self._iostat.read_begin()
+        try:
+            with self._reg.tp("shard_get"):
+                chaos.failpoint("blobnode.get_shard", node=self.node_id)
+                data = self._chunk(vuid).get(bid, offset, size)
+            self._reg.counter("shard_get_bytes_total").add(len(data))
+            # corrupt-on-read models wire/DMA corruption past the CRC framing
+            return chaos.corrupt_bytes("blobnode.get_shard.data", data,
+                                       node=self.node_id)
+        finally:
+            if self._iostat is not None:
+                self._iostat.read_done(
+                    len(data), int((_time.perf_counter() - t0) * 1e6))
 
     def mark_delete_shard(self, vuid: int, bid: int) -> None:
         self._chunk(vuid).mark_delete(bid)
@@ -562,3 +600,5 @@ class BlobNode:
     def close(self):
         for d in self.disks.values():
             d.close()
+        if self._iostat is not None:
+            self._iostat.close()

@@ -77,7 +77,7 @@ class AccessGateway:
         router = build_router(access)
         if router_hook is not None:
             router_hook(router)
-        self.server = RPCServer(router, host=host, port=port)
+        self.server = RPCServer(router, host=host, port=port, module="access")
         self.server.start()
         self.addr = self.server.addr
 

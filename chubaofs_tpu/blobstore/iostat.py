@@ -25,9 +25,14 @@ def _shm_dir() -> str:
 
 
 class IOStat:
-    """Single-writer counter block; one per (module, disk)."""
+    """Single-writer counter block; one per (module, disk).
+
+    The same counters also mirror into the `iostat` role registry (gauges
+    labeled by block name) so a daemon's /metrics carries them — the shm
+    block stays the node-side zero-HTTP view, the registry the scrape view."""
 
     def __init__(self, name: str, path: str | None = None):
+        self.name = name
         self.path = path or os.path.join(_shm_dir(), f"cfs-iostat-{name}")
         fd = os.open(self.path, os.O_CREAT | os.O_RDWR, 0o644)
         try:
@@ -36,9 +41,18 @@ class IOStat:
         finally:
             os.close(fd)
         self._vals = dict.fromkeys(_FIELDS, 0)
+        from chubaofs_tpu.utils.exporter import registry
+
+        # gauges bound ONCE (labels never change): _flush runs per IO and
+        # must not pay a registry-lock lookup per field per operation
+        lab = {"name": name}
+        self._gauges = [(f, registry("iostat").gauge(f, lab))
+                        for f in _FIELDS]
 
     def _flush(self):
         self._mm[:] = _BLOCK.pack(*(self._vals[f] for f in _FIELDS))
+        for f, g in self._gauges:
+            g.set(self._vals[f])
 
     def read_begin(self):
         self._vals["rpending"] += 1
@@ -66,6 +80,13 @@ class IOStat:
 
     def close(self):
         self._mm.close()
+        # a closed block's mirror gauges must not render as a live idle
+        # node on every later scrape
+        from chubaofs_tpu.utils.exporter import registry
+
+        lab = {"name": self.name}
+        for f, _ in self._gauges:
+            registry("iostat").unregister(f, lab)
 
     @staticmethod
     def view(path: str) -> dict:

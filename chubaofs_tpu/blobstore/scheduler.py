@@ -37,7 +37,7 @@ from chubaofs_tpu.blobstore.proxy import (
     Proxy,
 )
 from chubaofs_tpu.codec.service import CodecService, default_service
-from chubaofs_tpu.utils.exporter import default_registry
+from chubaofs_tpu.utils.exporter import registry
 
 TASK_PREPARED = "prepared"
 TASK_WORKING = "working"
@@ -294,7 +294,7 @@ class Scheduler:
                     self.proxy.send_shard_repair(vid, bid, bad, "inspect")
                     produced += 1
         if produced:
-            default_registry().counter("scheduler_inspect_findings").add(produced)
+            registry("scheduler").counter("inspect_findings").add(produced)
         return produced
 
     def drop_disk(self, disk_id: int) -> Task:
@@ -336,7 +336,7 @@ class Scheduler:
                 # the source would just ping-pong units back and forth
                 if self.cm.disks[dest].chunk_count + min_gap > src.chunk_count:
                     continue
-                default_registry().counter("scheduler_balance_tasks").add()
+                registry("scheduler").counter("balance_tasks").add()
                 return self._new_task(kind=KIND_BALANCE, vid=vol.vid,
                                       disk_id=src.disk_id,
                                       dest_disk_id=dest)

@@ -7,7 +7,12 @@ access gateway can log one line covering the whole fan-out (used at
 access/stream_put.go:47,100). Kept: trace-id propagation, child spans, track
 logs appended bottom-up. The carrier is a plain dict standing in for HTTP
 headers (inject/extract), so both in-process and HTTP hops propagate the same
-way.
+way; the packet TCP wire carries the same two fields in its arg blob
+(proto/packet.py trace_inject/trace_reply).
+
+Track logs are BOUNDED: at most TRACK_MAX entries per span (a failpoint-looped
+fan-out must not blow the response-header budget), and module names are
+sanitized (`;`/newlines/`:` would corrupt the ';'-joined wire form).
 """
 
 from __future__ import annotations
@@ -19,20 +24,46 @@ import uuid
 TRACE_ID_KEY = "Trace-Id"
 TRACK_LOG_KEY = "Trace-Tracklog"
 
+# hard cap on track entries per span: deep fan-outs degrade to a truncated
+# track log, never to an unbounded response header
+TRACK_MAX = 64
+_ENTRY_MAX = 128  # one hostile module name must not be the whole header
+
 _local = threading.local()
+
+_SANITIZE = str.maketrans({";": "_", ":": "_", "\n": "_", "\r": "_"})
+# a whole entry keeps its own "module:ms" colon; only the separators that
+# would corrupt the ';'-joined wire form are rewritten
+_SANITIZE_ENTRY = str.maketrans({";": "_", "\n": "_", "\r": "_"})
+
+
+def sanitize_module(module: str) -> str:
+    """Track-log entries are ';'-joined and ':'-split downstream; a module
+    name carrying either (or newlines, which break log lines) is rewritten."""
+    return str(module).translate(_SANITIZE)[:_ENTRY_MAX]
 
 
 class Span:
     def __init__(self, operation: str, trace_id: str | None = None,
                  parent: "Span | None" = None):
         self.operation = operation
-        self.trace_id = trace_id or (parent.trace_id if parent else uuid.uuid4().hex[:16])
+        # lazy: the id mints on first READ. Dispatch loops create a span per
+        # packet/VFS op unconditionally; an untraced op whose id nobody asks
+        # for must not pay os.urandom entropy on the hot path.
+        self._trace_id = trace_id or (parent.trace_id if parent else None)
         self.parent = parent
         self.start = time.perf_counter()
         self.tags: dict[str, object] = {}
         self.logs: list[tuple[float, str]] = []
-        self.track: list[str] = []  # track-log entries, e.g. "blobnode:12ms"
+        self.track: list[str] = []  # track-log entries, e.g. "blobnode:12"
+        self.track_dropped = 0  # entries the TRACK_MAX cap swallowed
         self.finished_us: int | None = None
+
+    @property
+    def trace_id(self) -> str:
+        if self._trace_id is None:
+            self._trace_id = uuid.uuid4().hex[:16]
+        return self._trace_id
 
     # -- opentracing-style surface ---------------------------------------------
     def set_tag(self, k: str, v) -> "Span":
@@ -42,20 +73,41 @@ class Span:
     def log(self, msg: str):
         self.logs.append((time.perf_counter() - self.start, msg))
 
+    def _push_track(self, entry: str):
+        if len(self.track) >= TRACK_MAX:
+            self.track_dropped += 1
+            return
+        self.track.append(entry)
+
     def append_track_log(self, module: str, start: float | None = None,
                          err: Exception | None = None):
-        """stream_put.go:100-style: module + elapsed + error class."""
+        """stream_put.go:100-style: module + elapsed ms + error class."""
         ms = int(((time.perf_counter() - (start or self.start)) * 1000))
-        entry = f"{module}:{ms}"
+        entry = f"{sanitize_module(module)}:{ms}"
         if err is not None:
-            entry += f"/{type(err).__name__}"
-        self.track.append(entry)
+            entry += f"/{sanitize_module(type(err).__name__)}"
+        self._push_track(entry)
+
+    def merge_track(self, entries):
+        """Fold a remote hop's track entries (list or ';'-joined string) into
+        this span, sanitized and bounded — the client side of a reply that
+        carried a track log back."""
+        if not entries:
+            return
+        if isinstance(entries, str):
+            entries = entries.split(";")
+        for e in entries:
+            e = str(e).translate(_SANITIZE_ENTRY)[:_ENTRY_MAX]
+            if e:
+                self._push_track(e)
 
     def finish(self):
         if self.finished_us is None:
             self.finished_us = int((time.perf_counter() - self.start) * 1e6)
             if self.parent is not None:
-                self.parent.track.extend(self.track)
+                for e in self.track:
+                    self.parent._push_track(e)
+                self.parent.track_dropped += self.track_dropped
 
     def __enter__(self):
         push_span(self)
@@ -75,13 +127,26 @@ class Span:
     def track_log_string(self) -> str:
         return ";".join(self.track)
 
+    def modules(self) -> set[str]:
+        """Distinct module names present in the track log."""
+        return {e.split(":", 1)[0] for e in self.track if e}
+
+
+def extract_trace_id(carrier: dict | None) -> str | None:
+    """Trace id from a carrier dict, tolerant of lower-cased header keys
+    (rpc Request lower-cases everything)."""
+    if not carrier:
+        return None
+    return carrier.get(TRACE_ID_KEY) or carrier.get(TRACE_ID_KEY.lower())
+
 
 def start_span(operation: str, carrier: dict | None = None) -> Span:
     """New root (or remote-continued, when carrier holds a trace id) span."""
-    tid = carrier.get(TRACE_ID_KEY) if carrier else None
-    span = Span(operation, trace_id=tid)
-    if carrier and TRACK_LOG_KEY in carrier:
-        span.track.extend(carrier[TRACK_LOG_KEY].split(";"))
+    span = Span(operation, trace_id=extract_trace_id(carrier))
+    if carrier:
+        tl = carrier.get(TRACK_LOG_KEY) or carrier.get(TRACK_LOG_KEY.lower())
+        if tl:
+            span.merge_track(tl)
     return span
 
 

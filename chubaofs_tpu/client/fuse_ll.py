@@ -47,8 +47,10 @@ import threading
 import time
 
 from chubaofs_tpu import chaos
+from chubaofs_tpu.blobstore import trace
 from chubaofs_tpu.meta.metanode import OpError
 from chubaofs_tpu.sdk.fs import FsClient, FsError
+from chubaofs_tpu.utils.auditlog import record_slow_op
 
 # -- fuse_kernel.h: opcodes ----------------------------------------------------
 
@@ -247,6 +249,12 @@ class FuseServer:
                 continue  # ops are synchronous; nothing in flight to cancel
             t0 = time.perf_counter()
             err = ""
+            # root span per kernel request: SDK/metanode/raft hops below
+            # attach their track entries, so one slow VFS call explains
+            # itself hop by hop in the slow-op log
+            op_label = self._AUDITED.get(opcode, f"op{opcode}")
+            span = trace.Span(f"fuse.{op_label}")
+            trace.push_span(span)
             try:
                 handler = self._DISPATCH.get(opcode)
                 if handler is None:
@@ -268,11 +276,15 @@ class FuseServer:
                 err = "EIO"
                 self._reply_err(unique, errno_mod.EIO)
             finally:
+                span.append_track_log("fuse", start=t0)
+                span.finish()
+                trace.pop_span()
+                elapsed = time.perf_counter() - t0
+                record_slow_op("fuse", op_label, elapsed, span=span, err=err)
                 if self.audit is not None and opcode in self._AUDITED:
-                    us = int((time.perf_counter() - t0) * 1e6)
                     self.audit.log_fs_op(
                         self.client_id, self.volume, self._AUDITED[opcode],
-                        f"ino{nodeid}", err=err, latency_us=us)
+                        f"ino{nodeid}", err=err, latency_us=int(elapsed * 1e6))
             if opcode == FUSE_DESTROY:
                 return
 

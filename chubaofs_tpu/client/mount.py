@@ -22,8 +22,9 @@ import os
 import threading
 import time
 
+from chubaofs_tpu.blobstore import trace
 from chubaofs_tpu.sdk.fs import FsClient, FsError
-from chubaofs_tpu.utils.auditlog import AuditLog
+from chubaofs_tpu.utils.auditlog import AuditLog, record_slow_op
 
 
 class MountError(FsError):
@@ -72,6 +73,11 @@ class Mount:
 
         t0 = time.perf_counter()
         err = ""
+        # the fs-op root (or child, under a caller's span): every meta/data
+        # hop below hangs its track entry off this one trace id — the
+        # FUSE→SDK→metanode→raft chain reads as one track log
+        span = trace.child_of(trace.current_span(), f"mount.{op}")
+        trace.push_span(span)
         try:
             return fn()
         except FsError as e:
@@ -83,10 +89,14 @@ class Mount:
             err = e.code
             raise MountError(e.code, path) from None
         finally:
+            span.append_track_log("fuse", start=t0)
+            span.finish()
+            trace.pop_span()
+            elapsed = time.perf_counter() - t0
+            record_slow_op("fuse", op, elapsed, span=span, err=err)
             if self.audit:
-                us = int((time.perf_counter() - t0) * 1e6)
                 self.audit.log_fs_op(self.client_id, self.volume, op, path,
-                                     err=err, latency_us=us)
+                                     err=err, latency_us=int(elapsed * 1e6))
 
     # -- caches ----------------------------------------------------------------
 

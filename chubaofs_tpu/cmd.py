@@ -62,6 +62,20 @@ def _log(daemon: str, msg: str) -> None:
     print(f"[{daemon}] {msg}", file=sys.stderr, flush=True)
 
 
+def _stats_server(cfg: dict, module: str) -> RPCServer:
+    """Tiny HTTP side-door for daemons whose primary wire is packet TCP
+    (metanode, datanode): mounts /metrics (the process's whole registry set,
+    role-namespaced) so EVERY role is scrapeable. `statsListen` in config;
+    port 0 (default) binds an ephemeral port, "off" disables."""
+    from chubaofs_tpu.rpc.router import Router
+
+    listen = cfg.get("statsListen", "127.0.0.1:0")
+    if listen == "off":
+        return None
+    host, port = _addr_split(listen)
+    return RPCServer(Router(), host=host, port=port, module=module).start()
+
+
 def _admin_ticket(cfg: dict):
     """Ticket credential for ticket-gated masters. Preferred: authnode client
     credentials (authAddrs + authClientId + authClientKey b64) — a renewing
@@ -199,7 +213,8 @@ class MasterDaemon(_Daemon):
                              service_secret=svc_secret.encode() if svc_secret else None,
                              admin_ticket_key=ticket_key or None)
         host, port = _addr_split(cfg.get("listen", "127.0.0.1:0"))
-        self.server = RPCServer(self.api.router, host=host, port=port).start()
+        self.server = RPCServer(self.api.router, host=host, port=port,
+                                module="master").start()
         self.addr = self.server.addr
         self.ticker = TickLoop([self.raft], interval=cfg.get("tickInterval", 0.02))
         self.ticker.start()
@@ -420,6 +435,8 @@ class MetaNodeDaemon(_Daemon):
         self.addr = _advertise(self.service.addr, cfg)
         self.mc = MasterClient(cfg["masterAddrs"],
                                admin_ticket=_admin_ticket(cfg))
+        self.stats_server = _stats_server(cfg, "metanode")
+        self.stats_addr = self.stats_server.addr if self.stats_server else ""
         self.ticker = TickLoop([self.raft], interval=cfg.get("tickInterval", 0.02))
         self.ticker.start()
         try:
@@ -545,6 +562,8 @@ class MetaNodeDaemon(_Daemon):
         super().stop()
         self.ticker.stop()
         self.service.close()
+        if self.stats_server is not None:
+            self.stats_server.stop()
         self.net.close()
 
 
@@ -570,6 +589,8 @@ class DataNodeDaemon(_Daemon):
         self.addr = _advertise(self.datanode.addr, cfg)
         self.mc = MasterClient(cfg["masterAddrs"],
                                admin_ticket=_admin_ticket(cfg))
+        self.stats_server = _stats_server(cfg, "datanode")
+        self.stats_addr = self.stats_server.addr if self.stats_server else ""
         self.ticker = TickLoop([self.raft], interval=cfg.get("tickInterval", 0.02))
         self.ticker.start()
         try:
@@ -599,6 +620,8 @@ class DataNodeDaemon(_Daemon):
         super().stop()
         self.ticker.stop()
         self.datanode.stop()
+        if self.stats_server is not None:
+            self.stats_server.stop()
         self.net.close()
 
 
@@ -711,12 +734,22 @@ class ObjectNodeDaemon(_Daemon):
         self.objectnode = ObjectNode(self.cluster, users=users,
                                      region=cfg.get("region", "cfs"))
         host, port = _addr_split(cfg.get("listen", "127.0.0.1:0"))
-        self.server = RPCServer(self.objectnode.router, host=host, port=port).start()
+        # metrics=False: /metrics on the S3 surface would shadow the
+        # auth-wrapped GET /:bucket listing for a bucket named "metrics"
+        # and serve process internals unauthenticated — scrape the
+        # statsListen side-door instead
+        self.server = RPCServer(self.objectnode.router, host=host,
+                                port=port, module="objectnode",
+                                metrics=False).start()
         self.addr = self.server.addr
+        self.stats_server = _stats_server(cfg, "objectnode")
+        self.stats_addr = self.stats_server.addr if self.stats_server else ""
 
     def stop(self):
         super().stop()
         self.server.stop()
+        if self.stats_server is not None:
+            self.stats_server.stop()
 
 
 class AuthNodeDaemon(_Daemon):
@@ -739,7 +772,8 @@ class AuthNodeDaemon(_Daemon):
         router = build_router(self.authnode,
                               secret.encode() if secret else None)
         host, port = _addr_split(cfg.get("listen", "127.0.0.1:0"))
-        self.server = RPCServer(router, host=host, port=port).start()
+        self.server = RPCServer(router, host=host, port=port,
+                                module="authnode").start()
         self.addr = self.server.addr
         self.ticker = TickLoop([self.raft], interval=cfg.get("tickInterval", 0.02))
         self.ticker.start()
@@ -759,7 +793,8 @@ class ConsoleDaemon(_Daemon):
         from chubaofs_tpu.console import Console
 
         host, port = _addr_split(cfg.get("listen", "127.0.0.1:0"))
-        self.console = Console(cfg["masterAddrs"], host=host, port=port)
+        self.console = Console(cfg["masterAddrs"], host=host, port=port,
+                               metrics_addrs=cfg.get("metricsAddrs"))
         self.addr = self.console.addr
 
     def stop(self):
@@ -828,7 +863,11 @@ def main(argv: list[str] | None = None) -> int:
         jax.config.update("jax_platforms", plat)
     daemon = start_role(cfg)
     addr = getattr(daemon, "addr", "")
-    print(json.dumps({"role": cfg["role"], "addr": addr}), flush=True)
+    boot = {"role": cfg["role"], "addr": addr}
+    stats_addr = getattr(daemon, "stats_addr", "")
+    if stats_addr:
+        boot["stats_addr"] = stats_addr  # /metrics side-door (statsListen)
+    print(json.dumps(boot), flush=True)
     # SIGTERM (supervisors, ProcCluster.close) must run the same graceful
     # stop as ^C: the client role in particular holds a KERNEL MOUNT that
     # outlives the process unless unmounted here

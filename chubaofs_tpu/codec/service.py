@@ -91,8 +91,12 @@ class CodecService:
         self._closed = False
         self._lock = threading.Lock()
         # dispatcher observability: how well jobs coalesce into device batches
-        # (same counter shape as MultiRaft.drain_stats for the raft drain)
+        # (same counter shape as MultiRaft.drain_stats for the raft drain).
+        # The codec role registry (cfs_codec_*) is the primary surface; this
+        # dict is the legacy view, mutated only under _stats_lock so readers
+        # get consistent snapshots (stats_snapshot).
         self.stats = {"batches": 0, "jobs": 0, "max_batch": 0}
+        self._stats_lock = threading.Lock()
 
     def _ensure_started(self):
         with self._lock:
@@ -254,12 +258,30 @@ class CodecService:
                         if not j.future.done():
                             j.future.set_exception(e)
 
+    def stats_snapshot(self) -> dict:
+        """Consistent copy of the legacy counters (no torn reads)."""
+        with self._stats_lock:
+            return dict(self.stats)
+
+    def _record_batch(self, jobs: int, elapsed_s: float) -> None:
+        with self._stats_lock:
+            self.stats["batches"] += 1
+            self.stats["jobs"] += jobs
+            self.stats["max_batch"] = max(self.stats["max_batch"], jobs)
+        from chubaofs_tpu.utils.exporter import BATCH_BUCKETS, registry
+
+        reg = registry("codec")
+        reg.counter("batches_total").add()
+        reg.counter("jobs_total").add(jobs)
+        reg.summary("batch_jobs", buckets=BATCH_BUCKETS).observe(jobs)
+        reg.summary("dispatch_seconds").observe(elapsed_s)
+
     def _run_group(self, sig: tuple, jobs: list[_Job]):
+        import time as _time
+
+        t0 = _time.perf_counter()
         # jobs arrive pre-padded to the bucket: stacking is the whole job here
         stack = np.stack([j.data for j in jobs])
-        self.stats["batches"] += 1
-        self.stats["jobs"] += len(jobs)
-        self.stats["max_batch"] = max(self.stats["max_batch"], len(jobs))
         # both paths go through the host-boundary grouped entry: batches of
         # stripes are viewed (free numpy reshape) as MXU-row-filling groups
         # before they ever reach the device (rs.gf_matmul_hostbatch) — or,
@@ -273,6 +295,7 @@ class CodecService:
             from chubaofs_tpu.ops import bitmatrix
 
             out = mm(bitmatrix.expand_matrix(jobs[0].mat).astype(np.int8), stack)
+        self._record_batch(len(jobs), _time.perf_counter() - t0)
         for i, j in enumerate(jobs):
             j.future.set_result(out[i, :, : j.k])
 

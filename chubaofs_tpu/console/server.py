@@ -81,10 +81,16 @@ OVERVIEW_QUERY = """{
 
 class Console:
     def __init__(self, master_addrs: list[str], host: str = "127.0.0.1",
-                 port: int = 0):
+                 port: int = 0, metrics_addrs: list[str] | None = None):
+        """metrics_addrs: extra /metrics targets (metanode/datanode stats
+        side-doors, blobstore gateway...) the /api/metrics rollup scrapes in
+        addition to the masters."""
         self.mc = MasterClient(master_addrs)
+        self.master_addrs = list(master_addrs)
+        self.metrics_addrs = list(metrics_addrs or [])
         self.router = self._build()
-        self.server = RPCServer(self.router, host=host, port=port).start()
+        self.server = RPCServer(self.router, host=host, port=port,
+                                module="console").start()
         self.addr = self.server.addr
 
     def _graphql(self, query: str, variables=None) -> dict:
@@ -116,7 +122,36 @@ class Console:
             return Response.json(self._graphql(body.get("query", ""),
                                                body.get("variables")))
 
+        def scrape_one(addr: str) -> str:
+            from chubaofs_tpu.tools.cfsstat import scrape
+
+            try:
+                # cfsstat.scrape raises on non-200 too, so a misconfigured
+                # target (main API port instead of the stats side-door)
+                # lands in the UNREACHABLE marker rather than splicing an
+                # error body into the exposition
+                return f"# == target {addr} ==\n{scrape(addr, timeout=3)}"
+            except Exception as e:
+                # a bad address (no port), a non-HTTP port, a dead daemon:
+                # mark THIS target, keep serving the others
+                return f"# == target {addr} UNREACHABLE: {e} ==\n"
+
+        def metrics_rollup(req: Request):
+            """Scrape every known daemon's /metrics and concatenate, each
+            section prefixed with its target — the one-stop cluster scrape
+            (exporter rollup; the Consul-registration consumer's view).
+            Targets are scraped CONCURRENTLY so dead daemons cost one
+            timeout, not one per corpse."""
+            from concurrent.futures import ThreadPoolExecutor
+
+            targets = self.master_addrs + self.metrics_addrs
+            with ThreadPoolExecutor(max_workers=min(8, len(targets) or 1)) as pool:
+                sections = list(pool.map(scrape_one, targets))
+            return Response(200, {"Content-Type": "text/plain"},
+                            "".join(sections).encode())
+
         r.get("/api/overview", overview)
+        r.get("/api/metrics", metrics_rollup)
         r.post("/graphql", graphql_proxy)
         return r
 

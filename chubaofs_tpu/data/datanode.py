@@ -26,7 +26,10 @@ import os
 import shutil
 import threading
 
+import time
+
 from chubaofs_tpu import chaos
+from chubaofs_tpu.blobstore import trace
 from chubaofs_tpu.data.repl import FollowerAckError, ReplError, ReplServer
 from chubaofs_tpu.proto.packet import (
     OP_CREATE_EXTENT, OP_CREATE_PARTITION, OP_GET_PARTITION_METRICS,
@@ -34,8 +37,11 @@ from chubaofs_tpu.proto.packet import (
     OP_GET_WATERMARKS, OP_HEARTBEAT, OP_MARK_DELETE, OP_RANDOM_WRITE,
     OP_REPAIR_READ, OP_REPAIR_WRITE, OP_STREAM_READ, OP_TINY_DELETE_RECORD,
     OP_WRITE, Packet, RES_DISK_ERR, RES_ERR, RES_NOT_EXIST, RES_NOT_LEADER,
-    RES_OK, is_tiny_extent,
+    RES_OK, TRACE_ARG_KEY, is_tiny_extent, op_name, trace_extract,
+    trace_reply,
 )
+from chubaofs_tpu.utils.auditlog import record_slow_op
+from chubaofs_tpu.utils.exporter import registry
 from chubaofs_tpu.raft.server import MultiRaft, NotLeaderError, StateMachine
 from chubaofs_tpu.storage.extent_store import (
     ExtentNotFound, ExtentStore, MIN_NORMAL_EXTENT_ID, StorageError,
@@ -190,6 +196,7 @@ class DataNode:
         self.raft = raft
         self.repair_lanes = repair_lanes
         self._repair_sem = threading.BoundedSemaphore(repair_lanes)
+        self._reg = registry("datanode")  # bound once: dispatch is per-packet
         self.server = ReplServer(addr, self._dispatch)
         self.space.load_all(raft)
 
@@ -206,6 +213,29 @@ class DataNode:
     # -- dispatch (wrap_operator.go:80 analog) ---------------------------------
 
     def _dispatch(self, pkt: Packet) -> Packet:
+        """Op dispatch wrapped in the observability plane: per-op TP metrics
+        into the datanode role registry, a span continuing the packet's
+        trace (its track rides back in the reply arg — only for requests
+        that CARRIED a trace id, so pipelined write bursts whose acks drain
+        at flush don't flood the caller's bounded track log), and slow-op
+        audit over CFS_SLOWOP_MS."""
+        name = op_name(pkt.opcode)
+        traced = isinstance(pkt.arg, dict) and TRACE_ARG_KEY in pkt.arg
+        span = trace_extract(pkt, f"datanode.{name}")
+        trace.push_span(span)
+        t0 = time.perf_counter()
+        try:
+            with self._reg.tp("op", {"op": name}):
+                resp = self._dispatch_inner(pkt)
+            span.append_track_log("datanode", start=t0)
+            return trace_reply(resp, span) if traced else resp
+        finally:
+            span.finish()
+            trace.pop_span()
+            record_slow_op("datanode", name, time.perf_counter() - t0,
+                           span=span)
+
+    def _dispatch_inner(self, pkt: Packet) -> Packet:
         try:
             handler = self._HANDLERS[pkt.opcode]
         except KeyError:
@@ -347,7 +377,11 @@ class DataNode:
                 dp.pid, ("rw", pkt.extent_id, pkt.extent_offset, pkt.data))
         except NotLeaderError as e:  # deposed between the gate and the propose
             return pkt.reply(RES_NOT_LEADER, arg={"leader": e.leader})
+        t_wait = time.perf_counter()
         status, detail = fut.result(timeout=10)
+        span = trace.current_span()
+        if span is not None:  # waiter-side raft hop entry (commit wait)
+            span.append_track_log("raft", start=t_wait)
         if status != "ok":
             return pkt.reply(RES_ERR, arg={"error": detail})
         return pkt.reply()
@@ -424,6 +458,7 @@ class DataNode:
 
     def repair_partition(self, pid: int) -> int:
         """Reconcile every replica of pid; returns bytes streamed."""
+        self._reg.counter("repair_rounds_total").add()
         dp = self.space.partitions.get(pid)
         if dp is None:
             raise ExtentNotFound(f"partition {pid}")
@@ -482,6 +517,8 @@ class DataNode:
                         self.server.request(peer, Packet(
                             OP_MARK_DELETE, partition_id=pid, extent_id=eid,
                             extent_offset=off, arg={"size": size}))
+        if streamed:
+            self._reg.counter("repair_bytes_total").add(streamed)
         return streamed
 
     def _stream_repair_extent(self, dp: DataPartition, eid: int, source: str,

@@ -182,8 +182,12 @@ class MasterAPI:
             reg.gauge("vol_data_partitions", lv).set(len(vol.data_partitions))
             reg.gauge("vol_dp_rw", lv).set(
                 sum(1 for dp in vol.data_partitions if dp.status == "rw"))
+        # the cluster rollups plus this PROCESS's role registries (raft drain
+        # counters etc.) — one scrape covers both views of a master daemon
+        from chubaofs_tpu.utils import exporter
+
         return Response(200, {"Content-Type": "text/plain; version=0.0.4"},
-                        reg.render().encode())
+                        (reg.render() + exporter.render_all()).encode())
 
     def get_zone_domains(self, req: Request):
         """zone -> fault domain map (master/topology.go:43 domain mode)."""

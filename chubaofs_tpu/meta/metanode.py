@@ -95,7 +95,26 @@ class MetaNode:
         return [self._chain_result(f) for f in futs]
 
     def submit_sync(self, partition_id: int, op: str, timeout: float = 5.0, **args):
-        return self.submit(partition_id, op, **args).result(timeout)
+        import time
+
+        from chubaofs_tpu.blobstore import trace
+
+        span = trace.current_span()
+        t0 = time.perf_counter()
+        fut = self.submit(partition_id, op, **args)
+        t_wait = time.perf_counter()
+        result = fut.result(timeout)
+        if span is not None:
+            # appended HERE, by the waiter, after the commit resolved — a
+            # raft-layer done-callback would race this thread's reply
+            # construction/span.finish and lose the entry
+            span.append_track_log("raft", start=t_wait)
+            # in-process callers get their "metanode" hop entry here; under
+            # a MetaService handler the SERVICE span already appends one
+            # covering the whole dispatch — one entry per hop either way
+            if not span.operation.startswith("metanode."):
+                span.append_track_log("metanode", start=t0)
+        return result
 
     # -- read ops: leader-local ------------------------------------------------
 

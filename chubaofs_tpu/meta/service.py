@@ -20,22 +20,47 @@ import json
 import socket
 import threading
 
+import time
+
+from chubaofs_tpu.blobstore import trace
 from chubaofs_tpu.meta.metanode import MetaNode, OpError
+from chubaofs_tpu.meta.partition import MetaPartitionSM
 from chubaofs_tpu.meta.wire import dec, enc
 from chubaofs_tpu.proto.packet import (
     OP_META_OP,
+    TRACE_ARG_KEY,
     Packet,
     RES_ERR,
     RES_NOT_LEADER,
     RES_OK,
     recv_packet,
     send_packet,
+    trace_extract,
+    trace_inject,
+    trace_merge,
+    trace_reply,
 )
 from chubaofs_tpu.raft.server import NotLeaderError
+from chubaofs_tpu.utils.auditlog import record_slow_op
+from chubaofs_tpu.utils.exporter import registry
 
 # ops served from leader state without a raft round (metanode read path)
 READ_OPS = {"lookup", "get_inode", "read_dir", "multipart_get",
             "multipart_list", "quota_usage", "tx_status", "dump_namespace"}
+
+_ADMIN_OPS = {"admin_create_partition", "admin_remove_partition",
+              "admin_raft_config", "admin_partitions"}
+
+
+def _op_label(op: str) -> str:
+    """Metric label for an op name: the KNOWN op set verbatim, anything else
+    collapsed to "other" — the op string arrives off the wire, and a label
+    minted per arbitrary client string would grow the registry unboundedly
+    (the invariant obslint enforces for literal keys)."""
+    if op in READ_OPS or op in _ADMIN_OPS \
+            or hasattr(MetaPartitionSM, "_op_" + op):
+        return op
+    return "other"
 
 
 class MetaService:
@@ -43,6 +68,7 @@ class MetaService:
 
     def __init__(self, metanode: MetaNode, host: str = "127.0.0.1", port: int = 0):
         self.metanode = metanode
+        self._reg = registry("metanode")  # bound once: _handle is per-packet
         self.listener = socket.create_server((host, port))
         self.addr = f"{host}:{self.listener.getsockname()[1]}"
         self._stop = threading.Event()
@@ -72,9 +98,33 @@ class MetaService:
                 pass
 
     def _handle(self, pkt: Packet) -> Packet:
+        """Dispatch wrapper: continues the packet's trace (span pushed so the
+        partition/raft layers under the handler see it), counts per-op TP
+        metrics into the metanode role registry (exporter.NewTPCnt at
+        metanode/manager.go:109), sends the span's track log back in the
+        reply arg, and audits over-threshold ops."""
+        op = pkt.arg.get("op", "") if isinstance(pkt.arg, dict) else ""
+        # reply carries the track log ONLY for requests that brought a trace
+        # id (same guard as datanode dispatch): untraced callers on the
+        # hottest metadata path pay zero extra reply bytes
+        traced = isinstance(pkt.arg, dict) and TRACE_ARG_KEY in pkt.arg
+        span = trace_extract(pkt, f"metanode.{op or 'packet'}")
+        trace.push_span(span)
+        t0 = time.perf_counter()
+        try:
+            with self._reg.tp("meta_op", {"op": _op_label(op)}):
+                resp = self._handle_inner(pkt, op)
+            span.append_track_log("metanode", start=t0)
+            return trace_reply(resp, span) if traced else resp
+        finally:
+            span.finish()
+            trace.pop_span()
+            record_slow_op("metanode", _op_label(op) if op else "packet",
+                           time.perf_counter() - t0, span=span)
+
+    def _handle_inner(self, pkt: Packet, op: str) -> Packet:
         if pkt.opcode != OP_META_OP:
             return pkt.reply(RES_ERR, arg={"error": f"bad opcode {pkt.opcode:#x}"})
-        op = pkt.arg.get("op", "")
         args = dec(json.loads(pkt.data.decode())) if pkt.data else {}
         pid = pkt.partition_id
         try:
@@ -156,8 +206,9 @@ class RemoteMetaNode:
             self._local.sock = None
 
     def _call(self, pid: int, op: str, **args):
-        pkt = Packet(opcode=OP_META_OP, partition_id=pid, arg={"op": op},
-                     data=json.dumps(enc(args)).encode())
+        pkt = trace_inject(Packet(opcode=OP_META_OP, partition_id=pid,
+                                  arg={"op": op},
+                                  data=json.dumps(enc(args)).encode()))
         # connect failures are ECONN (nothing was sent — always safe to retry
         # elsewhere); failures after send are EIO (the op may have applied, so
         # only idempotent ops retry — sdk/meta's same distinction)
@@ -172,6 +223,7 @@ class RemoteMetaNode:
         except (ConnectionError, OSError) as e:
             self._drop_conn()
             raise OpError("EIO", f"metanode {self.addr}: {e}") from None
+        trace_merge(resp)  # fold the metanode's track log into our span
         if resp.result == RES_NOT_LEADER:
             raise NotLeaderError(resp.arg.get("leader"))
         if resp.result != RES_OK:

@@ -41,6 +41,23 @@ OP_REMOVE_PARTITION = 0x0E  # admin: drop a retired partition replica
 # name, so ~40 distinct OpMeta opcodes collapse to a tagged envelope
 OP_META_OP = 0x20
 
+# opcode -> short name, for metric labels and trace/track entries (bounded
+# cardinality by construction: the opcode set IS the label set)
+OP_NAMES = {
+    OP_CREATE_EXTENT: "create_extent", OP_WRITE: "write",
+    OP_STREAM_READ: "stream_read", OP_RANDOM_WRITE: "random_write",
+    OP_MARK_DELETE: "mark_delete", OP_GET_WATERMARKS: "get_watermarks",
+    OP_REPAIR_READ: "repair_read", OP_REPAIR_WRITE: "repair_write",
+    OP_GET_PARTITION_METRICS: "partition_metrics", OP_HEARTBEAT: "heartbeat",
+    OP_CREATE_PARTITION: "create_partition",
+    OP_TINY_DELETE_RECORD: "tiny_delete", OP_RAFT_CONFIG: "raft_config",
+    OP_REMOVE_PARTITION: "remove_partition", OP_META_OP: "meta_op",
+}
+
+
+def op_name(opcode: int) -> str:
+    return OP_NAMES.get(opcode, f"op_{opcode:#x}")
+
 # -- result codes (proto/packet.go OpOk/OpErr/... analog) ----------------------
 RES_OK = 0x00
 RES_ERR = 0x01
@@ -139,6 +156,49 @@ class Packet:
 
     def error(self) -> str:
         return self.arg.get("error", f"result={self.result}")
+
+
+# -- trace carrier on the packet wire ------------------------------------------
+# The binary header is fixed; the trace id and returning track log ride the
+# JSON arg blob under reserved keys (the reference packs follower addrs into
+# its arg bytes the same way). Requests carry "_trace"; replies carry "_track".
+
+TRACE_ARG_KEY = "_trace"
+TRACK_ARG_KEY = "_track"
+
+
+def trace_inject(pkt: "Packet") -> "Packet":
+    """Attach the CURRENT thread span's trace id to an outgoing request."""
+    from chubaofs_tpu.blobstore import trace
+
+    span = trace.current_span()
+    if span is not None:
+        pkt.arg[TRACE_ARG_KEY] = span.trace_id
+    return pkt
+
+
+def trace_extract(pkt: "Packet", operation: str):
+    """Server side: a span continuing the packet's trace (or a fresh root)."""
+    from chubaofs_tpu.blobstore import trace
+
+    tid = pkt.arg.get(TRACE_ARG_KEY) if isinstance(pkt.arg, dict) else None
+    return trace.Span(operation, trace_id=tid)
+
+
+def trace_reply(resp: "Packet", span) -> "Packet":
+    """Attach the server span's track log to an outgoing reply."""
+    if span is not None and span.track:
+        resp.arg[TRACK_ARG_KEY] = list(span.track)
+    return resp
+
+
+def trace_merge(resp: "Packet") -> None:
+    """Client side: fold a reply's track log into the current span."""
+    from chubaofs_tpu.blobstore import trace
+
+    span = trace.current_span()
+    if span is not None and isinstance(resp.arg, dict):
+        span.merge_track(resp.arg.get(TRACK_ARG_KEY))
 
 
 # -- socket framing ---------------------------------------------------------------

@@ -35,6 +35,7 @@ from collections import deque
 from concurrent.futures import Future
 
 from chubaofs_tpu import chaos
+from chubaofs_tpu.blobstore import trace
 from chubaofs_tpu.raft import codec
 from chubaofs_tpu.raft.core import Entry, Msg, NotLeaderError, RaftCore, ROLE_LEADER
 
@@ -257,9 +258,13 @@ class MultiRaft:
         self._pump_started = False
         self._pump_lock = threading.Lock()
         self.pump_dead = False  # a drain crash poisons the node: fail fast
-        # group-commit observability: how well proposals coalesce (the
-        # codec-service dispatcher keeps the same counter shape)
+        # group-commit observability. The role registry (cfs_raft_*) is the
+        # primary surface — counters + a batch-occupancy histogram rendered
+        # by every daemon's /metrics; drain_stats stays as the legacy dict
+        # view (perfbench resets/reads it), updated only under _stats_lock
+        # so readers can take a consistent snapshot.
         self.drain_stats = {"rounds": 0, "entries": 0, "max_batch": 0}
+        self._stats_lock = threading.Lock()
         net.register(self)
 
     # -- group lifecycle -----------------------------------------------------
@@ -466,6 +471,11 @@ class MultiRaft:
                 fut: Future = Future()
                 g.pending_futs.append(fut)
                 futs.append(fut)
+        # NOTE on tracing: the "raft:<ms>" track entry is appended by the
+        # WAITER thread after future.result() (metanode submit_sync, the
+        # datanode random-write handler) — a done-callback here would race
+        # the waiter's span.finish()/reply construction and drop the entry
+        # nondeterministically (Future runs callbacks after waking waiters).
         self._dirty.append(g)
         self._ensure_pump()
         self._prop_wake.set()
@@ -521,6 +531,36 @@ class MultiRaft:
                 self.net.send(out)
             window = self.GROUP_WINDOW if biggest > 1 else 0.0
 
+    def _record_drain(self, batch: int) -> None:
+        """One drained batch: bump the legacy dict (under its lock) and the
+        raft role registry (drain counters + batch-size histogram)."""
+        with self._stats_lock:
+            st = self.drain_stats
+            st["rounds"] += 1
+            st["entries"] += batch
+            st["max_batch"] = max(st["max_batch"], batch)
+        try:
+            from chubaofs_tpu.utils.exporter import BATCH_BUCKETS, registry
+
+            reg = registry("raft")
+            reg.counter("drain_rounds_total").add()
+            reg.counter("drain_entries_total").add(batch)
+            reg.summary("drain_batch", buckets=BATCH_BUCKETS).observe(batch)
+        except Exception:
+            pass  # metrics must never poison the drain pump (pump_dead)
+
+    def drain_stats_snapshot(self) -> dict:
+        """Consistent copy of the legacy counters (no torn multi-field
+        reads — rounds/entries/max_batch all from one instant)."""
+        with self._stats_lock:
+            return dict(self.drain_stats)
+
+    def drain_stats_reset(self) -> None:
+        """Zero the legacy counters under the lock (bench epochs); the
+        registry counters stay cumulative, as counters must."""
+        with self._stats_lock:
+            self.drain_stats.update(rounds=0, entries=0, max_batch=0)
+
     def _drain_pending(self, g: _Group) -> list[Msg]:
         """Drain the group's pending proposals (held lock: self._lock). Each
         round is ONE core log-append of up to max_batch entries, ONE WAL
@@ -546,10 +586,7 @@ class MultiRaft:
                     break
                 if not idxs:
                     break  # queue raced empty: nothing left to drain
-                st = self.drain_stats
-                st["rounds"] += 1
-                st["entries"] += len(idxs)
-                st["max_batch"] = max(st["max_batch"], len(idxs))
+                self._record_drain(len(idxs))
                 futs = [g.pending_futs.popleft() for _ in idxs]
                 for idx, fut in zip(idxs, futs):
                     g.waiters[idx] = (core.term, fut)

@@ -14,6 +14,7 @@ import time
 import zlib
 
 from chubaofs_tpu import chaos
+from chubaofs_tpu.blobstore import trace
 from chubaofs_tpu.rpc.errors import HTTPError
 from chubaofs_tpu.rpc.server import AUTH_HEADER, CRC_HEADER, sign_path
 
@@ -45,6 +46,12 @@ class RPCClient:
             hdrs[AUTH_HEADER] = sign_path(self.auth_secret, plain)
         if crc and body:
             hdrs[CRC_HEADER] = str(zlib.crc32(body) & 0xFFFFFFFF)
+        # cross-hop tracing: the caller's span id rides the request headers;
+        # the server's track log rides back on the response and folds into
+        # the same span (blobstore/common/trace's header carrier)
+        span = trace.current_span()
+        if span is not None:
+            hdrs.setdefault(trace.TRACE_ID_KEY, span.trace_id)
         last: Exception | None = None
         for attempt in range(self.retries):
             host = self._next_host()
@@ -58,7 +65,11 @@ class RPCClient:
                     resp = conn.getresponse()
                     data = resp.read()
                     if resp.status < 500:
-                        return resp.status, dict(resp.getheaders()), data
+                        headers_out = dict(resp.getheaders())
+                        if span is not None:
+                            span.merge_track(
+                                headers_out.get(trace.TRACK_LOG_KEY))
+                        return resp.status, headers_out, data
                     last = HTTPError.from_body(resp.status, data)
                 finally:
                     conn.close()

@@ -68,14 +68,33 @@ def audit_middleware(audit):
 
 
 class RPCServer:
-    """ThreadingHTTPServer hosting one Router; /metrics mounted by default."""
+    """ThreadingHTTPServer hosting one Router; /metrics mounted by default.
+
+    /metrics renders the process's WHOLE registry set (the default registry
+    plus every role registry — exporter.render_all), so any daemon role is
+    scrapeable without its subsystems knowing about the server; an explicit
+    `registry` argument is rendered first (legacy callers). A router that
+    already mounted its own /metrics keeps it (registration order wins at
+    equal rank). `module` names the daemon role in trace track-logs.
+    `metrics=False` skips the mount — for PUBLIC-facing routers whose
+    namespace the route would shadow (the objectnode S3 surface, where
+    GET /metrics is a bucket listing and every route is auth-wrapped);
+    such daemons expose a statsListen side-door instead."""
 
     def __init__(self, router: Router, host: str = "127.0.0.1", port: int = 0,
-                 registry=None):
+                 registry=None, module: str = "", metrics: bool = True):
         self.router = router
-        if registry is not None:
-            router.get("/metrics", lambda r: Response(
-                200, {"Content-Type": "text/plain"}, registry.render().encode()))
+        self.module = module
+
+        def metrics_route(r):
+            from chubaofs_tpu.utils import exporter
+
+            text = (registry.render() if registry is not None else "")
+            return Response(200, {"Content-Type": "text/plain"},
+                            (text + exporter.render_all()).encode())
+
+        if metrics:
+            router.get("/metrics", metrics_route)
 
         outer = self
         self._inflight = 0
@@ -106,7 +125,32 @@ class RPCServer:
                 # error/hang here = handler dies before replying: the client
                 # sees a dropped connection, its retry/backoff path fires
                 chaos.failpoint("rpc.server.handle")
-                resp = outer.router.dispatch(req)
+                # continue (or root) the request's trace: handlers see the
+                # span via trace.current_span(); its track log rides back on
+                # the response headers for the caller to fold in
+                from chubaofs_tpu.blobstore import trace
+
+                # Trace-* response headers only when the REQUEST carried a
+                # trace id (same guard as the packet carriers): untraced
+                # callers — every plain S3 client, every scraper — pay zero
+                # extra reply bytes; the span still exists for handlers'
+                # current_span() use
+                traced = trace.extract_trace_id(req.headers) is not None
+                span = trace.start_span(
+                    f"{outer.module or 'rpc'}:{req.path}", carrier=req.headers)
+                trace.push_span(span)
+                t0 = time.perf_counter()
+                try:
+                    resp = outer.router.dispatch(req)
+                finally:
+                    span.append_track_log(outer.module or "rpc", start=t0)
+                    span.finish()
+                    trace.pop_span()
+                if traced:
+                    if span.track:
+                        resp.headers.setdefault(trace.TRACK_LOG_KEY,
+                                                span.track_log_string())
+                    resp.headers.setdefault(trace.TRACE_ID_KEY, span.trace_id)
                 self.send_response(resp.status)
                 payload = b"" if self.command == "HEAD" else resp.body
                 for k, v in resp.headers.items():

@@ -98,6 +98,7 @@ class MetaWrapper:
 
     def submit(self, mp: MetaPartitionView, op: str, **args):
         from chubaofs_tpu import chaos
+        from chubaofs_tpu.blobstore import trace
 
         chaos.failpoint("meta.submit")
         # the uniq id makes the mutation idempotent end-to-end, so even an
@@ -106,10 +107,21 @@ class MetaWrapper:
         # wall time stamps ride the proposal so every replica applies the
         # identical ctime/mtime (no clock reads inside the state machine)
         args.setdefault("_now", time.time())
-        return self._on_partition(
-            mp, lambda node: node.submit_sync(mp.partition_id, op, **args),
-            idempotent=True,
-        )
+        # one child span per mutation: downstream hops (metanode service,
+        # raft drain) hang their track entries off the same trace id
+        with trace.child_of(trace.current_span(), f"meta.{op}") as span:
+            err: Exception | None = None
+            try:
+                return self._on_partition(
+                    mp,
+                    lambda node: node.submit_sync(mp.partition_id, op, **args),
+                    idempotent=True,
+                )
+            except Exception as e:
+                err = e
+                raise
+            finally:
+                span.append_track_log("meta", err=err)
 
     # -- the ll API (api.go analogs) -------------------------------------------
 

@@ -138,6 +138,9 @@ class ExtentClient:
                 hosts: list[str] | None = None) -> Packet:
         import time as _time
 
+        from chubaofs_tpu.proto.packet import trace_inject, trace_merge
+
+        trace_inject(pkt)  # datanode hops join the caller's trace
         last = None
         if hosts is None:
             hosts = dp["hosts"] if retry_hosts else dp["hosts"][:1]
@@ -161,6 +164,7 @@ class ExtentClient:
                 if reply.result == RES_NOT_LEADER:
                     last = StreamError(f"{addr}: not leader")
                     continue
+                trace_merge(reply)
                 return reply
             if _time.time() >= deadline:
                 break
@@ -200,12 +204,15 @@ class ExtentHandler:
         return self.sock
 
     def open(self) -> None:
+        from chubaofs_tpu.proto.packet import trace_inject, trace_merge
+
         t0 = time.perf_counter()
-        req = Packet(OP_CREATE_EXTENT, partition_id=self.dp["pid"],
-                     arg={"followers": self.followers})
+        req = trace_inject(Packet(OP_CREATE_EXTENT, partition_id=self.dp["pid"],
+                                  arg={"followers": self.followers}))
         sock = self._conn()
         send_packet(sock, req)
         rep = recv_packet(sock)
+        trace_merge(rep)
         self.client.record_latency(self.dp["pid"], time.perf_counter() - t0)
         if rep.result != RES_OK:
             raise StreamError(f"create extent: {rep.error()}")

@@ -288,6 +288,9 @@ class ExtentStore:
                 f.seek(blk * BLOCK_SIZE)
                 got = zlib.crc32(f.read(BLOCK_SIZE))
                 if got != want:
+                    from chubaofs_tpu.utils.exporter import registry
+
+                    registry("datanode").counter("crc_mismatch_total").add()
                     raise BrokenExtent(f"extent {extent_id} block {blk}")
 
     def block_crc(self, extent_id: int, block: int) -> int:

@@ -1,0 +1,134 @@
+"""cfs-stat — scrape a daemon's /metrics twice and diff the snapshots.
+
+The `iostat`-style ops companion to the observability plane: point it at any
+daemon role's /metrics (master API, metanode/datanode statsListen side-door,
+blobstore gateway, console rollup), take two snapshots `--interval` seconds
+apart, and print per-metric deltas + rates — so a perf investigation reads
+raft drain-batch and codec-batch counters moving in real time instead of
+eyeballing two raw exposition dumps.
+
+Usage:
+    python -m chubaofs_tpu.tools.cfsstat --addr 127.0.0.1:17010 \
+        [--interval 5] [--path /metrics] [--filter raft] [--json]
+
+Also a library: parse_metrics / diff_metrics are the exposition-format
+consumers the conformance tests drive.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import sys
+import time
+
+
+def parse_metrics(text: str) -> dict[str, float]:
+    """Prometheus text exposition -> {'name{labels}': value}. Comment/TYPE
+    lines are skipped; malformed lines raise (the conformance contract —
+    a bad render must fail loudly here, not scrape as garbage)."""
+    out: dict[str, float] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        key, _, val = line.rpartition(" ")
+        if not key:
+            raise ValueError(f"unparseable metric line: {line!r}")
+        out[key] = float(val)
+    return out
+
+
+def parse_types(text: str) -> dict[str, str]:
+    """# TYPE declarations -> {metric_family: kind}."""
+    out: dict[str, str] = {}
+    for line in text.splitlines():
+        parts = line.strip().split()
+        if len(parts) == 4 and parts[0] == "#" and parts[1] == "TYPE":
+            out[parts[2]] = parts[3]
+    return out
+
+
+def diff_metrics(before: dict[str, float], after: dict[str, float],
+                 interval_s: float) -> list[dict]:
+    """Per-metric rows: value now, delta across the window, rate/s.
+    Metrics new in `after` diff against 0; vanished ones are dropped."""
+    rows = []
+    for key in sorted(after):
+        b = before.get(key, 0.0)
+        a = after[key]
+        delta = a - b
+        rows.append({
+            "metric": key,
+            "value": a,
+            "delta": round(delta, 6),
+            "rate": round(delta / interval_s, 6) if interval_s > 0 else 0.0,
+        })
+    return rows
+
+
+def scrape(addr: str, path: str = "/metrics", timeout: float = 10.0) -> str:
+    host, port = addr.rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        body = resp.read().decode("utf-8", "replace")
+        if resp.status != 200:
+            raise OSError(f"{addr}{path}: HTTP {resp.status}: {body[:200]}")
+        return body
+    finally:
+        conn.close()
+
+
+def main(argv=None, out=None) -> int:
+    import argparse
+
+    out = out or sys.stdout
+    p = argparse.ArgumentParser(
+        prog="cfs-stat", description="scrape + diff two /metrics snapshots")
+    p.add_argument("--addr", required=True, help="daemon host:port")
+    p.add_argument("--path", default="/metrics")
+    p.add_argument("--interval", type=float, default=5.0,
+                   help="seconds between the two snapshots")
+    p.add_argument("--filter", default="",
+                   help="only metrics whose name contains this substring")
+    p.add_argument("--all", action="store_true",
+                   help="include zero-delta metrics")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    try:
+        t0 = time.monotonic()
+        before = parse_metrics(scrape(args.addr, args.path))
+        time.sleep(max(0.0, args.interval))
+        after = parse_metrics(scrape(args.addr, args.path))
+        elapsed = time.monotonic() - t0
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+    rows = diff_metrics(before, after, elapsed)
+    if args.filter:
+        rows = [r for r in rows if args.filter in r["metric"]]
+    if not args.all:
+        rows = [r for r in rows if r["delta"] != 0]
+    if args.json:
+        print(json.dumps({"interval_s": round(elapsed, 3), "rows": rows},
+                         indent=2), file=out)
+        return 0
+    if not rows:
+        print(f"(no metric moved in {elapsed:.1f}s; --all shows statics)",
+              file=out)
+        return 0
+    w = max(len(r["metric"]) for r in rows)
+    print(f"{'METRIC'.ljust(w)}  {'VALUE':>14}  {'DELTA':>12}  {'RATE/S':>12}",
+          file=out)
+    for r in rows:
+        print(f"{r['metric'].ljust(w)}  {r['value']:>14g}  "
+              f"{r['delta']:>12g}  {r['rate']:>12g}", file=out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

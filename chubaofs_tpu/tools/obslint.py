@@ -1,0 +1,136 @@
+"""obslint — static lint for the observability plane's two invariants.
+
+1. **No high-cardinality metric labels.** A label whose KEY names a per-object
+   id (inode, blob id, volume id, extent id, request/trace id, path, upload
+   id) explodes the registry: every distinct value mints a fresh time series,
+   and one busy volume turns /metrics into a memory leak. Label sets must be
+   bounded by construction (op names, reasons, disk kinds).
+
+2. **No new ad-hoc stats dicts.** Counters live in `exporter.Registry` (role
+   registries), where they are locked, rendered, and scrape-able — not in
+   `self.stats = {...}` dict literals that every subsystem reinvents and no
+   endpoint can see. The two pre-registry dicts that were MIGRATED to the
+   registry (raft drain, codec batches) remain as documented read-only legacy
+   views and are allowlisted here.
+
+Wired into tier-1 (tests/test_obslint.py) so a regression fails fast.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+# label keys that smell like unbounded per-object ids
+BANNED_LABEL_KEYS = {
+    "ino", "inode", "bid", "blob_id", "vid", "vuid", "extent", "extent_id",
+    "req_id", "request_id", "trace_id", "path", "upload_id", "key", "tx_id",
+    "partition_id",
+}
+
+# metric-emitting call attributes whose `labels` argument we inspect
+_METRIC_METHODS = {"counter", "gauge", "summary", "tp"}
+
+# (path suffix, attribute) pairs of the documented legacy stat dicts — the
+# registry migration kept them as read-only views for perfbench/tests
+ALLOWED_STATS_DICTS = {
+    ("raft/server.py", "drain_stats"),
+    ("codec/service.py", "stats"),
+}
+
+
+def _labels_arg(call: ast.Call) -> ast.expr | None:
+    """The labels argument of a metric call: 2nd positional or labels=."""
+    if len(call.args) >= 2:
+        return call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "labels":
+            return kw.value
+    return None
+
+
+def lint_source(src: str, relpath: str) -> list[str]:
+    """Lint one file's source; returns human-readable findings."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{relpath}: syntax error: {e}"]
+    findings: list[str] = []
+    for node in ast.walk(tree):
+        # -- rule 1: banned/high-cardinality metric label keys --------------
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _METRIC_METHODS:
+            labels = _labels_arg(node)
+            if isinstance(labels, ast.Dict):
+                for k, v in zip(labels.keys, labels.values):
+                    if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                        if k.value.lower() in BANNED_LABEL_KEYS:
+                            findings.append(
+                                f"{relpath}:{node.lineno}: metric label key "
+                                f"{k.value!r} is a per-object id — unbounded "
+                                "cardinality; put the id in the trace/log, "
+                                "not a label")
+                    if isinstance(v, ast.JoinedStr):
+                        findings.append(
+                            f"{relpath}:{node.lineno}: metric label value is "
+                            "an f-string — interpolated ids mint unbounded "
+                            "series; use a bounded enum value")
+        # -- rule 2: ad-hoc self.*stats* = {...} dict counters --------------
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"
+                        and ("stats" in tgt.attr or tgt.attr.endswith("_counters"))):
+                    if any(relpath.endswith(sfx) and tgt.attr == attr
+                           for sfx, attr in ALLOWED_STATS_DICTS):
+                        continue
+                    findings.append(
+                        f"{relpath}:{node.lineno}: ad-hoc stats dict "
+                        f"`self.{tgt.attr} = {{...}}` — counters belong in "
+                        "exporter.registry(<role>) so /metrics can render "
+                        "them (allowlisted legacy views excepted)")
+    return findings
+
+
+def run(root: str | None = None) -> list[str]:
+    """Lint every .py file under the package; returns all findings."""
+    if root is None:
+        import chubaofs_tpu
+
+        root = os.path.dirname(os.path.abspath(chubaofs_tpu.__file__))
+    findings: list[str] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in sorted(filenames):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, root)
+            with open(path, encoding="utf-8") as f:
+                findings.extend(lint_source(f.read(), rel))
+    return findings
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="cfs-obslint",
+        description="lint metric-label cardinality + ad-hoc stats dicts")
+    p.add_argument("root", nargs="?", default=None,
+                   help="directory to lint (default: the installed package)")
+    args = p.parse_args(argv)
+    findings = run(args.root)
+    for f in findings:
+        print(f, file=sys.stderr)
+    if findings:
+        print(f"obslint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    print("obslint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -360,3 +360,30 @@ def test_master_metrics_endpoint(tmp_path):
         assert (leaders, followers) == (1, 2), (leaders, followers)
     finally:
         c.close()
+
+
+def test_daemon_stats_sidedoor_metrics(cluster):
+    """metanode/datanode daemons (packet-TCP primary wire) expose /metrics
+    on their statsListen HTTP side-door: role-namespaced output including
+    raft drain counters with histogram buckets (observability plane)."""
+    import http.client
+
+    def scrape(addr):
+        host, port = addr.rsplit(":", 1)
+        conn = http.client.HTTPConnection(host, int(port), timeout=10)
+        conn.request("GET", "/metrics")
+        resp = conn.getresponse()
+        body = resp.read().decode()
+        conn.close()
+        assert resp.status == 200
+        return body
+
+    mn = cluster["metas"][0]
+    dn = cluster["datas"][0]
+    assert mn.stats_addr and dn.stats_addr
+    body = scrape(mn.stats_addr)
+    # the metanode registered + heartbeats through raft-backed masters, and
+    # this PROCESS hosts raft groups: drain metrics render with buckets
+    assert "cfs_raft_drain_rounds_total" in body
+    assert "cfs_raft_drain_batch_bucket{" in body
+    assert scrape(dn.stats_addr)  # datanode side-door serves too

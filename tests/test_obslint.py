@@ -1,0 +1,47 @@
+"""obslint static pass — the observability plane's CI guardrails (fast;
+wired into tier-1 so high-cardinality labels and new ad-hoc stats dicts
+fail the build, ISSUE 3 satellite)."""
+
+import textwrap
+
+from chubaofs_tpu.tools import obslint
+
+
+def test_repo_is_clean():
+    findings = obslint.run()
+    assert findings == [], "\n".join(findings)
+
+
+def test_flags_high_cardinality_label_key():
+    src = textwrap.dedent("""
+        def f(reg, ino):
+            reg.counter("ops", {"ino": str(ino)}).add()
+    """)
+    findings = obslint.lint_source(src, "x.py")
+    assert len(findings) == 1 and "ino" in findings[0]
+
+
+def test_flags_fstring_label_value():
+    src = textwrap.dedent("""
+        def f(reg, bid):
+            reg.gauge("depth", {"shard": f"blob-{bid}"}).set(1)
+    """)
+    findings = obslint.lint_source(src, "x.py")
+    assert len(findings) == 1 and "f-string" in findings[0]
+
+
+def test_flags_adhoc_stats_dict():
+    src = textwrap.dedent("""
+        class S:
+            def __init__(self):
+                self.stats = {"count": 0}
+    """)
+    findings = obslint.lint_source(src, "somewhere/new.py")
+    assert len(findings) == 1 and "ad-hoc stats dict" in findings[0]
+
+
+def test_allows_legacy_views_and_bounded_labels():
+    legacy = 'class A:\n    def __init__(self):\n        self.stats = {"batches": 0}\n'
+    assert obslint.lint_source(legacy, "codec/service.py") == []
+    bounded = 'def f(reg, op):\n    reg.counter("ops", {"op": op}).add()\n'
+    assert obslint.lint_source(bounded, "x.py") == []
