@@ -5,11 +5,18 @@ timestamp, client addr, volume, op, path, error, latency, ino — written to a
 rotating file set with a shrink-on-total-size policy) and the blobstore HTTP
 auditlog middleware (common/rpc/auditlog). One implementation serves both: a
 `AuditLog` with `log_fs_op` / `log_http` formatters over the same rotor.
-"""
+
+Slow-op audit: any op slower than the `CFS_SLOWOP_MS` threshold emits one
+STRUCTURED record — module, op, trace id, the span's whole track log, latency
+— through the same rotor discipline, so a single slow FUSE create or access
+PUT explains itself hop by hop (the blobstore access gateway's slow-request
+track-log line, generalized to every entry point)."""
 
 from __future__ import annotations
 
+import json
 import os
+import tempfile
 import threading
 import time
 
@@ -93,3 +100,124 @@ class AuditLog:
 
     def close(self):
         self._rotor.close()
+
+
+# -- slow-op audit (CFS_SLOWOP_MS) ---------------------------------------------
+
+
+class SlowOpLog:
+    """Structured slow-op trail: one JSON line per over-threshold op, with
+    the op's trace id and track log so the latency is attributable hop by
+    hop. Threshold in milliseconds; <= 0 disables (the default)."""
+
+    def __init__(self, logdir: str, threshold_ms: float = 0.0,
+                 max_bytes: int = 4 << 20, max_files: int = 4):
+        self.threshold_ms = threshold_ms
+        self._rotor = RotatingFile(logdir, "slowop", max_bytes, max_files)
+
+    def maybe_log(self, module: str, op: str, latency_s: float,
+                  span=None, err: str = "") -> bool:
+        """Record the op if it crossed the threshold; True when logged."""
+        ms = latency_s * 1e3
+        if self.threshold_ms <= 0 or ms < self.threshold_ms:
+            return False
+        rec = {"ts": time.strftime("%Y-%m-%d %H:%M:%S"),
+               "module": module, "op": op, "latency_ms": round(ms, 3)}
+        if span is not None:
+            rec["trace_id"] = span.trace_id
+            rec["track"] = span.track_log_string()
+        if err:
+            rec["err"] = err
+        self._rotor.write_line(json.dumps(rec))
+        return True
+
+    def records(self) -> list[dict]:
+        out = []
+        for line in self._rotor.read_lines():
+            try:
+                out.append(json.loads(line))
+            except ValueError:
+                continue
+        return out
+
+    def close(self):
+        self._rotor.close()
+
+
+_slowop: SlowOpLog | None = None
+_slowop_lock = threading.Lock()
+
+
+_env_ms_cache: float | None = None
+
+
+def _env_threshold_ms() -> float:
+    """CFS_SLOWOP_MS, parsed ONCE — the disabled fast path in every packet/
+    fs-op dispatch must not pay an environ lookup per call. Overrides after
+    startup go through configure_slowop()."""
+    global _env_ms_cache
+    if _env_ms_cache is None:
+        try:
+            _env_ms_cache = float(os.environ.get("CFS_SLOWOP_MS", "0") or 0)
+        except ValueError:
+            _env_ms_cache = 0.0
+    return _env_ms_cache
+
+
+def slowop_log() -> SlowOpLog:
+    """The process-wide slow-op log. Directory from `CFS_SLOWOP_DIR` (default
+    a per-process dir under the system tmpdir), threshold from
+    `CFS_SLOWOP_MS` — both re-read on first use; tests reconfigure via
+    configure_slowop()."""
+    global _slowop
+    with _slowop_lock:
+        if _slowop is None:
+            logdir = os.environ.get("CFS_SLOWOP_DIR") or os.path.join(
+                tempfile.gettempdir(), f"cfs-slowop-{os.getpid()}")
+            _slowop = SlowOpLog(logdir, threshold_ms=_env_threshold_ms())
+        return _slowop
+
+
+def configure_slowop(logdir: str | None = None,
+                     threshold_ms: float | None = None) -> SlowOpLog:
+    """(Re)bind the process slow-op log — daemons point it at their log dir,
+    tests at a tmpdir with a tiny threshold."""
+    global _slowop
+    with _slowop_lock:
+        if _slowop is not None and logdir is not None:
+            _slowop.close()
+            _slowop = None
+        if _slowop is None:
+            _slowop = SlowOpLog(
+                logdir or os.environ.get("CFS_SLOWOP_DIR") or os.path.join(
+                    tempfile.gettempdir(), f"cfs-slowop-{os.getpid()}"),
+                threshold_ms=(_env_threshold_ms() if threshold_ms is None
+                              else threshold_ms))
+        elif threshold_ms is not None:
+            _slowop.threshold_ms = threshold_ms
+        return _slowop
+
+
+def record_slow_op(module: str, op: str, latency_s: float, span=None,
+                   err: str = "") -> bool:
+    """Entry-point hook: cheap when disabled (one cached float compare, no
+    files ever opened), one JSON line + a metrics counter when the op
+    crossed CFS_SLOWOP_MS. NEVER raises — it runs in serve loops' finally
+    blocks (FUSE dispatch, packet dispatch), where a full disk or an
+    unwritable CFS_SLOWOP_DIR must degrade to lost audit lines, not to a
+    dead mount."""
+    try:
+        if _slowop is None and _env_threshold_ms() <= 0:
+            return False  # disabled and never configured: no rotor to create
+        log = slowop_log()
+        if log.threshold_ms <= 0:
+            return False
+        if not log.maybe_log(module, op, latency_s, span=span, err=err):
+            return False
+        from chubaofs_tpu.utils.exporter import registry
+
+        registry("slowop").counter("slow_ops_total",
+                                   {"module": module, "op": op}).add()
+        return True
+    except Exception:
+        return False
