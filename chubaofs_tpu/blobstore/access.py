@@ -22,9 +22,11 @@ from __future__ import annotations
 import hashlib
 import hmac
 import json
+import os
 import threading
 import time
 import zlib
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FutureTimeout
 from dataclasses import dataclass, field
@@ -39,7 +41,7 @@ from chubaofs_tpu.codec.codemode import CodeMode, get_tactic
 from chubaofs_tpu.codec.service import CodecService, default_service
 from chubaofs_tpu.utils.auditlog import record_slow_op
 from chubaofs_tpu.utils.breaker import CircuitBreaker
-from chubaofs_tpu.utils.exporter import registry
+from chubaofs_tpu.utils.exporter import BATCH_BUCKETS, registry
 
 MAX_BLOB_SIZE = 4 * 1024 * 1024
 
@@ -64,6 +66,11 @@ class DiskPunished(AccessError):
     """Disk is in its punish window after repeated errors/timeouts — writes
     fail fast instead of queueing behind a wedged device (stream_put.go:303-340
     punishDisk analog)."""
+
+
+class _PipelineAborted(Exception):
+    """Internal: a later pipeline stage was skipped because an earlier blob's
+    quorum already failed — never user-visible (the first real error wins)."""
 
 
 @dataclass(frozen=True)
@@ -205,6 +212,21 @@ class Access:
                                             thread_name_prefix="access-probe-io")
         self._probing: set[tuple[int, int]] = set()  # (vid, bid) dedupe
         self._probe_lock = threading.Lock()
+        # data-path pipeline: bounded encode->write overlap window for
+        # multi-blob PUTs, and blob-level GET readahead depth. 0 = serial.
+        self.pipeline_window = int(os.environ.get("CFS_PIPELINE_WINDOW", "3"))
+        # how many blobs may be ENCODED ahead of the write window: wide
+        # enough that the codec service still forms full device batches
+        # (window-sized encode submission would cap batches at 2-4 jobs),
+        # bounded so a 1000-blob object doesn't materialize 1000 stripes
+        self.encode_ahead = int(os.environ.get("CFS_PUT_ENCODE_AHEAD", "16"))
+        self.max_blob_size = MAX_BLOB_SIZE
+        # blob-level pipeline stages get their OWN executor: a PUT stage
+        # blocks on a codec future plus shard fan-outs running on self._pool
+        # (and a GET stage on self._read_pool) — running stages on either of
+        # those pools would let W blocked stages starve their own shard IO
+        self._pipe_pool = ThreadPoolExecutor(max_workers=8,
+                                             thread_name_prefix="access-pipe")
 
     # -- failure containment --------------------------------------------------
 
@@ -281,47 +303,212 @@ class Access:
         )
         loc = Location(cluster_id=self.cluster_id, code_mode=mode, size=len(data), crc=zlib.crc32(data))
 
-        blobs = [data[i : i + MAX_BLOB_SIZE] for i in range(0, len(data), MAX_BLOB_SIZE)]
+        blobs = [data[i : i + self.max_blob_size]
+                 for i in range(0, len(data), self.max_blob_size)]
         first_bid, _ = self._alloc_breaker.call(self.proxy.alloc_bids, len(blobs))
-
-        # encode all blobs first (they batch inside the codec service), then
-        # fan shard writes out per blob
-        futures = []
-        metas = []
         t = get_tactic(mode)
+        window = int(self.pipeline_window)
+        if window >= 1 and len(blobs) > 1:
+            loc.blobs.extend(self._put_pipelined(t, mode, blobs, first_bid,
+                                                 window))
+        else:
+            loc.blobs.extend(self._put_serial(t, mode, blobs, first_bid))
+        loc.signature = self._sign(loc)
+        return loc
+
+    @staticmethod
+    def _cancel_encodes(enc_futs: dict) -> None:
+        """Best-effort cancel of encode-ahead futures a failed pipeline will
+        never consume: queued codec jobs are dropped before device work
+        (the service's running-handshake makes this race-free); running
+        ones finish and are discarded — waste bounded by encode_ahead."""
+        for f in enc_futs.values():
+            f.cancel()
+        enc_futs.clear()
+
+    def _encode_blob(self, t, blob: bytes):
+        """Submit one blob to the codec service; returns the stripe future.
+        One composed-matrix device pass yields global AND local parity."""
+        shard_len = t.shard_size(len(blob))
+        mat = np.zeros((t.N, shard_len), np.uint8)
+        flat = mat.reshape(-1)
+        flat[: len(blob)] = np.frombuffer(blob, np.uint8)
+        return self.codec.encode_tactic(t, mat)
+
+    def _write_blob(self, t, mode: int, vol: VolumeInfo, bid: int,
+                    stripe: np.ndarray) -> VolumeInfo:
+        """Stripe write with the full-volume rotation retry; returns the
+        volume the blob actually landed on. The grant set rotates across
+        active_vols volumes that fill in LOCKSTEP, so a re-alloc after
+        retiring one full volume may hand back one of its equally-full
+        siblings — allow one rotation per granted volume before a fresh
+        replacement is guaranteed; the final attempt propagates."""
+        rotations = getattr(self.proxy, "active_vols", 1) + 1
+        for _ in range(rotations):
+            try:
+                self._write_stripe(t, vol, bid, stripe)
+                return vol
+            except VolumeFullError:
+                # rotate: retire the full volume, take another, retry
+                self.cm.set_volume_status(vol.vid, "idle")
+                self.proxy.invalidate(mode)
+                vol = self._alloc_breaker.call(self.proxy.alloc_volume, mode)
+        self._write_stripe(t, vol, bid, stripe)
+        return vol
+
+    def _put_serial(self, t, mode: int, blobs: list[bytes],
+                    first_bid: int) -> list[Blob]:
+        """Pre-pipeline path (pipeline_window=0 or single blob): encode all
+        blobs first (they batch inside the codec service), then fan shard
+        writes out per blob, one blob at a time."""
         from chubaofs_tpu.blobstore import trace
 
         span = trace.current_span()
+        futures = []
+        metas = []
         for i, blob in enumerate(blobs):
             t_alloc = time.perf_counter()
             vol = self._alloc_breaker.call(self.proxy.alloc_volume, mode)
             if span is not None:
                 span.append_track_log("proxy", start=t_alloc)
-            shard_len = t.shard_size(len(blob))
-            mat = np.zeros((t.N, shard_len), np.uint8)
-            flat = mat.reshape(-1)
-            flat[: len(blob)] = np.frombuffer(blob, np.uint8)
-            # one composed-matrix device pass yields global AND local parity
-            futures.append(self.codec.encode_tactic(t, mat))
+            futures.append(self._encode_blob(t, blob))
             metas.append((first_bid + i, vol, len(blob)))
 
+        out = []
         for fut, (bid, vol, size) in zip(futures, metas):
             t_enc = time.perf_counter()
             stripe = fut.result()  # (total, shard_len), locals included
             if span is not None:
                 span.append_track_log("codec", start=t_enc)
-            try:
-                self._write_stripe(t, vol, bid, stripe)
-            except VolumeFullError:
-                # rotate: retire the full volume, take a fresh one, retry once
-                self.cm.set_volume_status(vol.vid, "idle")
-                self.proxy.invalidate(mode)
-                vol = self._alloc_breaker.call(self.proxy.alloc_volume, mode)
-                self._write_stripe(t, vol, bid, stripe)
-            loc.blobs.append(Blob(bid=bid, vid=vol.vid, size=size))
+            vol = self._write_blob(t, mode, vol, bid, stripe)
+            out.append(Blob(bid=bid, vid=vol.vid, size=size))
+        return out
 
-        loc.signature = self._sign(loc)
-        return loc
+    def _put_pipelined(self, t, mode: int, blobs: list[bytes], first_bid: int,
+                       window: int) -> list[Blob]:
+        """Windowed encode->write pipeline (the tentpole): volume alloc and
+        encode submission for blob i+1..i+W overlap blob i's shard fan-out,
+        with at most `window` stripes in flight — so the codec never starves
+        waiting on the network and the network never idles waiting on the
+        codec. Blob order in the returned list is bid order regardless of
+        completion order. A quorum failure aborts the window cleanly: stages
+        not yet started are skipped (no orphaned writes, no repair-queue spam
+        for blobs the client will never see), in-flight ones finish, and the
+        first failing blob's error is raised."""
+        from chubaofs_tpu.blobstore import trace
+
+        span = trace.current_span()
+        reg = registry("access")
+        occ = reg.summary("put_pipeline_occupancy", buckets=BATCH_BUCKETS)
+        abort = threading.Event()
+        vols: list[VolumeInfo | None] = [None] * len(blobs)
+        write_secs = [0.0] * len(blobs)
+
+        def stage(i: int, enc_fut, vol: VolumeInfo, bid: int):
+            if abort.is_set():
+                raise _PipelineAborted()
+            # the request span follows the stage onto the pipe worker so
+            # codec/blobnode track entries keep landing on the PUT's trace
+            if span is not None:
+                trace.push_span(span)
+            try:
+                t_enc = time.perf_counter()
+                stripe = enc_fut.result()
+                if span is not None:
+                    span.append_track_log("codec", start=t_enc)
+                if abort.is_set():
+                    raise _PipelineAborted()
+                t_w = time.perf_counter()
+                vols[i] = self._write_blob(t, mode, vol, bid, stripe)
+                write_secs[i] = time.perf_counter() - t_w
+            except _PipelineAborted:
+                raise
+            except BaseException:
+                abort.set()
+                raise
+            finally:
+                if span is not None:
+                    trace.pop_span()
+
+        inflight: deque = deque()  # (blob index, stage future)
+        first_err: tuple[int, Exception] | None = None
+
+        def reap_oldest():
+            nonlocal first_err
+            i, f = inflight.popleft()
+            try:
+                f.result()
+            except _PipelineAborted:
+                pass
+            except Exception as e:
+                if first_err is None or i < first_err[0]:
+                    first_err = (i, e)
+
+        t_wall = time.perf_counter()
+        # encodes run AHEAD of the write window (bounded by encode_ahead):
+        # the codec service still gathers full device batches — submitting
+        # encodes window-at-a-time would cap every batch at 2-4 jobs — while
+        # blob i's stripe is on the wire and blob i+1..i+W's stages drain
+        enc_futs: dict[int, object] = {}
+        next_enc = 0
+
+        def encode_up_to(limit: int):
+            nonlocal next_enc
+            while next_enc < min(limit, len(blobs)):
+                enc_futs[next_enc] = self._encode_blob(t, blobs[next_enc])
+                next_enc += 1
+
+        ahead = max(window, self.encode_ahead)
+        try:
+            for i, blob in enumerate(blobs):
+                while len(inflight) >= window:
+                    reap_oldest()
+                if abort.is_set():
+                    break
+                encode_up_to(i + ahead)
+                # alloc for blob i rides the caller thread while blob i-1's
+                # (and older, up to the window) fan-outs are still in flight
+                t_alloc = time.perf_counter()
+                vol = self._alloc_breaker.call(self.proxy.alloc_volume, mode)
+                if span is not None:
+                    span.append_track_log("proxy", start=t_alloc)
+                inflight.append(
+                    (i, self._pipe_pool.submit(stage, i, enc_futs.pop(i), vol,
+                                               first_bid + i)))
+                occ.observe(len(inflight))
+        except BaseException:
+            # a CALLER-side failure mid-window (alloc breaker open, cluster
+            # can't place a volume) must honor the same abort contract as a
+            # stage failure: stop unstarted stages, drain in-flight ones —
+            # never leave workers writing blobs the client will not see.
+            # A stage error collected while draining is the root cause (it
+            # likely tripped the breaker the caller then hit) and wins.
+            abort.set()
+            while inflight:
+                reap_oldest()
+            self._cancel_encodes(enc_futs)
+            if first_err is not None:
+                raise first_err[1]
+            raise
+        while inflight:
+            reap_oldest()
+        if first_err is not None or abort.is_set():
+            self._cancel_encodes(enc_futs)
+        if first_err is not None:
+            raise first_err[1]
+        if abort.is_set() or any(v is None for v in vols):
+            raise AccessError("put pipeline aborted")  # defensive: unreachable
+        # realized overlap: sum of per-stripe write times over the wall clock
+        # of the whole pipelined phase — >1.0 means stripes actually
+        # overlapped on the wire, ~1.0 means the window degenerated to serial
+        wall = time.perf_counter() - t_wall
+        busy = sum(write_secs)
+        if wall > 0 and busy > 0:
+            reg.summary("put_overlap_ratio",
+                        buckets=BATCH_BUCKETS).observe(busy / wall)
+        reg.counter("put_pipeline_blobs").add(len(blobs))
+        return [Blob(bid=first_bid + i, vid=vols[i].vid, size=len(b))
+                for i, b in enumerate(blobs)]
 
     def _write_stripe(self, t, vol: VolumeInfo, bid: int, stripe: np.ndarray):
         from chubaofs_tpu.blobstore import trace
@@ -453,7 +640,7 @@ class Access:
         if offset < 0 or size < 0 or offset + size > loc.size:
             raise AccessError(f"range [{offset}, {offset+size}) outside object of {loc.size}")
 
-        out = bytearray()
+        segs = []  # (blob, intra-blob offset, length) the range touches
         pos = 0
         for blob in loc.blobs:
             blob_start, blob_end = pos, pos + blob.size
@@ -462,7 +649,52 @@ class Access:
                 continue
             lo = max(0, offset - blob_start)
             hi = min(blob.size, offset + size - blob_start)
-            out += self._read_blob(loc.code_mode, blob, lo, hi - lo)
+            segs.append((blob, lo, hi - lo))
+        window = int(self.pipeline_window)
+        if len(segs) > 1 and window >= 1:
+            return self._get_readahead(loc.code_mode, segs, window)
+        out = bytearray()
+        for blob, lo, n in segs:
+            out += self._read_blob(loc.code_mode, blob, lo, n)
+        return bytes(out)
+
+    def _get_readahead(self, mode: int, segs: list, window: int) -> bytes:
+        """Multi-blob ranged GET with readahead: the next blobs' shard
+        gathers are prefetched on the pipe pool (their shard reads still ride
+        the read pool) while the current blob's bytes are consumed, bounded
+        by the same pipeline window as PUT. Byte order is segment order —
+        results are consumed strictly FIFO however the gathers complete."""
+        from chubaofs_tpu.blobstore import trace
+
+        span = trace.current_span()
+        reg = registry("access")
+        occ = reg.summary("get_readahead_occupancy", buckets=BATCH_BUCKETS)
+
+        def gather(blob, lo, n):
+            if span is not None:
+                trace.push_span(span)
+            try:
+                return self._read_blob(mode, blob, lo, n)
+            finally:
+                if span is not None:
+                    trace.pop_span()
+
+        q: deque = deque()
+        nxt = 0
+        out = bytearray()
+        try:
+            while q or nxt < len(segs):
+                while nxt < len(segs) and len(q) < window:
+                    q.append(self._pipe_pool.submit(gather, *segs[nxt]))
+                    if nxt > 0:  # segment 0 is the current read, not readahead
+                        reg.counter("get_readahead_prefetch").add()
+                    nxt += 1
+                occ.observe(len(q))
+                out += q.popleft().result()
+        except BaseException:
+            for f in q:  # queued prefetches must not run for a dead request
+                f.cancel()
+            raise
         return bytes(out)
 
     def _read_blob(self, mode: int, blob: Blob, offset: int, size: int) -> bytes:

@@ -327,6 +327,29 @@ class ClusterMgr:
                     return vol
             return self.create_volume(mode)
 
+    def alloc_volumes(self, code_mode: CodeMode | int,
+                      count: int = 1) -> list[VolumeInfo]:
+        """Up to `count` DISTINCT active volumes of the mode, creating the
+        shortfall (volumemgr's multi-volume grant): a pipelined PUT spreads
+        consecutive blobs across them so one chunk file's append lock never
+        serializes the whole window. Returns fewer when the cluster can't
+        place more volumes — never fails while at least one is allocatable."""
+        mode = int(code_mode)
+        # check + create under one (re-entrant) lock hold, like the singular
+        # alloc_volume: concurrent grantees must not both see the same
+        # shortfall and over-create volumes
+        with self._lock:
+            act = [v for v in self.volumes.values()
+                   if v.code_mode == mode and v.status == VOL_ACTIVE]
+            while len(act) < count:
+                try:
+                    act.append(self.create_volume(mode))
+                except ClusterError:
+                    if act:
+                        break
+                    raise
+            return act[:count]
+
     def set_volume_status(self, vid: int, status: str) -> None:
         """Retire full volumes (VOL_IDLE) so alloc_volume rotates to a new one."""
         self.apply("set_volume_status", {"vid": vid, "status": status})

@@ -86,10 +86,11 @@ class AccessGateway:
 
 
 class AccessClient:
-    """api/access client analog; mirrors the in-process Access surface."""
+    """api/access client analog; mirrors the in-process Access surface.
+    `pooled=False` forces connect-per-request (the perfbench A/B control)."""
 
-    def __init__(self, hosts: list[str], retries: int = 3):
-        self.rpc = RPCClient(hosts, retries=retries)
+    def __init__(self, hosts: list[str], retries: int = 3, pooled: bool = True):
+        self.rpc = RPCClient(hosts, retries=retries, pooled=pooled)
 
     def put(self, data: bytes) -> Location:
         status, _, body = self.rpc.do("PUT", "/put", data)

@@ -69,12 +69,20 @@ class Proxy:
     serving a volume that was retired, locked, or filled behind its back."""
 
     def __init__(self, cm: ClusterMgr, data_dir: str | None = None,
-                 alloc_ttl: float = 30.0):
+                 alloc_ttl: float = 30.0, active_vols: int | None = None):
         self.cm = cm
         self.alloc_ttl = alloc_ttl
+        # grants rotate round-robin over a SET of active volumes (the
+        # reference allocator keeps several volumes per mode in flight):
+        # consecutive blobs of one windowed PUT then land on different
+        # chunks/disks instead of serializing on one chunk's append lock
+        if active_vols is None:
+            active_vols = int(os.environ.get("CFS_PROXY_ACTIVE_VOLS", "2"))
+        self.active_vols = max(1, active_vols)
         self._lock = threading.Lock()
-        # code_mode -> (volume grant, monotonic expiry)
-        self._cached: dict[int, tuple[VolumeInfo, float]] = {}
+        # code_mode -> (volume grants, monotonic expiry)
+        self._cached: dict[int, tuple[list[VolumeInfo], float]] = {}
+        self._rr: dict[int, int] = {}
         d = data_dir
         self.topics = {
             TOPIC_SHARD_REPAIR: TopicQueue(os.path.join(d, "repair.jsonl") if d else None),
@@ -86,11 +94,18 @@ class Proxy:
     def alloc_volume(self, code_mode: int) -> VolumeInfo:
         now = time.monotonic()
         with self._lock:
-            vol, expires = self._cached.get(code_mode, (None, 0.0))
-            if vol is None or vol.status != "active" or now >= expires:
-                vol = self.cm.alloc_volume(code_mode)  # renewal from clustermgr
-                self._cached[code_mode] = (vol, now + self.alloc_ttl)
-            return vol
+            granted, expires = self._cached.get(code_mode, ([], 0.0))
+            vols = [v for v in granted if v.status == "active"]
+            # renew on TTL expiry AND whenever a granted volume was retired
+            # behind our back (len shrank): a thinned set would serialize
+            # the PUT window on one chunk for the rest of the TTL — the
+            # exact contention the rotating grant exists to prevent
+            if not vols or now >= expires or len(vols) < len(granted):
+                vols = self.cm.alloc_volumes(code_mode, self.active_vols)
+                self._cached[code_mode] = (vols, now + self.alloc_ttl)
+            i = self._rr.get(code_mode, 0)
+            self._rr[code_mode] = i + 1
+            return vols[i % len(vols)]
 
     def alloc_bids(self, count: int) -> tuple[int, int]:
         return self.cm.alloc_scope("bid", count)

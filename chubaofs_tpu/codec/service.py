@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import queue
 import threading
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -40,6 +40,20 @@ def bucket_len(k: int) -> int:
     while b < k:
         b *= 2
     return b
+
+
+class _ChainFuture(Future):
+    """Wrapper future whose cancel() propagates to the upstream codec job,
+    so a caller holding only the composed LRC result (encode_tactic) can
+    still drop the queued device work (access pipeline aborts)."""
+
+    def __init__(self, upstream: Future):
+        super().__init__()
+        self._upstream = upstream
+
+    def cancel(self) -> bool:
+        self._upstream.cancel()  # best-effort: running jobs finish
+        return super().cancel()
 
 
 @dataclass
@@ -139,13 +153,21 @@ class CodecService:
         job = _Job("matmul", t.N, t.M + t.L, _pad_to_bucket(data, k, kb),
                    k, kb, mat=mat)
         self._submit(job)
-        out: Future = Future()
+        out = _ChainFuture(job.future)
 
         def _finish(f: Future):
-            if f.exception():
-                out.set_exception(f.exception())
+            if f.cancelled() or out.cancelled():
+                # cancelled upstream (drain handshake dropped the job) or
+                # downstream (pipeline abort): nothing to deliver
                 return
-            out.set_result(np.concatenate([data, f.result()], axis=0))
+            try:
+                if f.exception():
+                    out.set_exception(f.exception())
+                else:
+                    out.set_result(
+                        np.concatenate([data, f.result()], axis=0))
+            except InvalidStateError:
+                pass  # out.cancel() raced the delivery: outcome discarded
 
         job.future.add_done_callback(_finish)
         return out
@@ -237,6 +259,14 @@ class CodecService:
                     if job is not None and not job.future.done():
                         job.future.set_exception(RuntimeError("CodecService closed"))
                 return
+            if not batch:
+                continue
+            # honor caller-side cancellation (pipeline aborts drop their
+            # encode-ahead jobs): a cancelled job is skipped before any
+            # device work, and the running-handshake means a later cancel()
+            # fails cleanly instead of racing set_result
+            batch = [j for j in batch
+                     if j.future.set_running_or_notify_cancel()]
             if not batch:
                 continue
             # group by compatible shape signature (kb was bucketed at

@@ -10,6 +10,8 @@ from chubaofs_tpu.rpc.errors import HTTPError, err_response
 from chubaofs_tpu.rpc.router import Request, Response, Router
 from chubaofs_tpu.rpc.server import RPCServer
 from chubaofs_tpu.rpc.client import RPCClient
+from chubaofs_tpu.rpc.pool import ConnectionPool, NullPool, default_pool
 
 __all__ = ["HTTPError", "err_response", "Request", "Response", "Router",
-           "RPCServer", "RPCClient"]
+           "RPCServer", "RPCClient", "ConnectionPool", "NullPool",
+           "default_pool"]

@@ -99,9 +99,20 @@ class RPCServer:
         outer = self
         self._inflight = 0
         self._drain = threading.Condition()
+        self._conns: set = set()  # live connection sockets (keep-alive aware)
 
         class Handler(BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
+
+            def setup(self):
+                super().setup()
+                with outer._drain:
+                    outer._conns.add(self.connection)
+
+            def finish(self):
+                with outer._drain:
+                    outer._conns.discard(self.connection)
+                super().finish()
 
             def log_message(self, *a):  # silence default stderr chatter
                 pass
@@ -191,5 +202,22 @@ class RPCServer:
                 if remaining <= 0:
                     break  # wedged handler: don't hold the restart hostage
                 self._drain.wait(remaining)
+            conns = list(self._conns)
+        # keep-alive connections OUTLIVE shutdown(): their handler threads sit
+        # in readline() waiting for the next request, and a pooled client
+        # would keep being served by THIS stopped stack (a reload would leave
+        # requests landing on closed components). Hard-close them — parked
+        # client conns see EOF and their pool evicts + reconnects fresh.
+        import socket as _socket
+
+        for c in conns:
+            try:
+                c.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                c.close()
+            except OSError:
+                pass
         if self._thread:
             self._thread.join(timeout=5)

@@ -17,7 +17,6 @@ consumers the conformance tests drive.
 
 from __future__ import annotations
 
-import http.client
 import json
 import sys
 import time
@@ -68,8 +67,12 @@ def diff_metrics(before: dict[str, float], after: dict[str, float],
 
 
 def scrape(addr: str, path: str = "/metrics", timeout: float = 10.0) -> str:
-    host, port = addr.rsplit(":", 1)
-    conn = http.client.HTTPConnection(host, int(port), timeout=timeout)
+    from chubaofs_tpu.rpc.pool import NullPool
+
+    # one-shot scrape: the NullPool keeps the no-direct-HTTPConnection
+    # invariant (obslint rule 3) without parking a socket per target
+    pool = NullPool(timeout=timeout)
+    conn, _ = pool.checkout(addr)
     try:
         conn.request("GET", path)
         resp = conn.getresponse()
@@ -78,7 +81,7 @@ def scrape(addr: str, path: str = "/metrics", timeout: float = 10.0) -> str:
             raise OSError(f"{addr}{path}: HTTP {resp.status}: {body[:200]}")
         return body
     finally:
-        conn.close()
+        pool.checkin(addr, conn)
 
 
 def main(argv=None, out=None) -> int:

@@ -13,6 +13,11 @@
    registry (raft drain, codec batches) remain as documented read-only legacy
    views and are allowlisted here.
 
+3. **No direct `http.client.HTTPConnection(...)` outside `rpc/pool.py`.**
+   Every HTTP connection rides the keep-alive pool (or its NullPool opt-out)
+   so reuse/evict counters stay truthful and the connect-per-request data
+   path can never be silently reintroduced.
+
 Wired into tier-1 (tests/test_obslint.py) so a regression fails fast.
 """
 
@@ -38,6 +43,9 @@ ALLOWED_STATS_DICTS = {
     ("raft/server.py", "drain_stats"),
     ("codec/service.py", "stats"),
 }
+
+# the ONE module allowed to construct HTTPConnection: the keep-alive pool
+CONN_POOL_PATH = "rpc/pool.py"
 
 
 def _labels_arg(call: ast.Call) -> ast.expr | None:
@@ -76,6 +84,17 @@ def lint_source(src: str, relpath: str) -> list[str]:
                             f"{relpath}:{node.lineno}: metric label value is "
                             "an f-string — interpolated ids mint unbounded "
                             "series; use a bounded enum value")
+        # -- rule 3: direct HTTPConnection construction outside the pool ----
+        if isinstance(node, ast.Call) and not relpath.endswith(CONN_POOL_PATH):
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if name in ("HTTPConnection", "HTTPSConnection"):
+                findings.append(
+                    f"{relpath}:{node.lineno}: direct {name}( construction — "
+                    "every HTTP conn rides rpc/pool.py (ConnectionPool or "
+                    "NullPool), so keep-alive reuse and evict counters stay "
+                    "truthful; the unpooled path must not sneak back")
         # -- rule 2: ad-hoc self.*stats* = {...} dict counters --------------
         if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
             for tgt in node.targets:

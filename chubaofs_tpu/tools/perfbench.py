@@ -239,6 +239,135 @@ def bench_raft_commit(wal_root: str, n_ops: int = 600) -> dict:
     return out
 
 
+def bench_put_pipeline(root: str, blob_kb: int = 64, n_puts: int = 8,
+                       blob_counts: tuple = (1, 4, 16),
+                       n_nodes: int = 6, wire_ms: float = 10.0) -> dict:
+    """Blobstore data-path pipeline A/B (ISSUE 4): PUT (and a widest-object
+    GET) throughput through a real AccessGateway HTTP hop, on two axes in
+    ONE run — pipeline on/off (windowed encode->write overlap vs the
+    serialized per-blob path) x pooled/unpooled RPC (keep-alive connection
+    pool vs connect-per-request). Multi-blob objects are forced by shrinking
+    max_blob_size, so the 16-blob config exercises the full window without
+    64 MiB objects.
+
+    Two latency regimes are emitted side by side: the raw in-process numbers
+    (blobnodes are objects in this process — the shard hop costs ~0 wire
+    time, so overlap can only exploit CPU/file-IO parallelism), and `_wire`
+    configs with a deterministic `wire_ms` per-shard delay injected at the
+    access.write_shard / access.read_shard chaos failpoints — the
+    deployment shape, where the gateway->blobnode hop is a network RTT and
+    hiding it behind the codec is the whole point of the pipeline (the
+    reference's own numbers ride a 10 Gb/s fabric, BASELINE.md). Also emits
+    the pipelined/serial speedups, the realized overlap ratio from the
+    access registry, and the rpc pool hit rate over the pooled phase."""
+    from chubaofs_tpu.blobstore.cluster import MiniCluster
+    from chubaofs_tpu.blobstore.gateway import AccessClient, AccessGateway
+    from chubaofs_tpu.utils import exporter
+
+    c = MiniCluster(os.path.join(root, "blob"), n_nodes=n_nodes,
+                    disks_per_node=2)
+    c.access.max_blob_size = blob_kb * 1024
+    gw = AccessGateway(c.access)
+    rpc_reg = exporter.registry("rpc")
+
+    def pool_ctrs() -> tuple[float, float]:
+        return (rpc_reg.counter("pool_reuse").value,
+                rpc_reg.counter("pool_miss").value)
+
+    out: dict = {}
+    rng_data = {nb: os.urandom(nb * blob_kb * 1024) for nb in blob_counts}
+    clients = {False: AccessClient([gw.addr], pooled=False),
+               True: AccessClient([gw.addr], pooled=True)}
+    variants = [(pooled, window)
+                for pooled in (False, True) for window in (0, 3)]
+    pool_hits = pool_misses = 0.0
+    try:
+        # warm every path once (vuid creation, codec jit shapes, pool fill)
+        for pooled, window in variants:
+            c.access.pipeline_window = window
+            loc = clients[pooled].put(rng_data[max(blob_counts)])
+            assert clients[pooled].get(loc) == rng_data[max(blob_counts)]
+        def one_variant(pooled: bool, window: int, suffix: str):
+            nonlocal pool_hits, pool_misses
+            client = clients[pooled]
+            c.access.pipeline_window = window
+            variant = (f"{'pipe' if window else 'serial'}_"
+                       f"{'pooled' if pooled else 'nopool'}")
+            if pooled:
+                reuse0, miss0 = pool_ctrs()
+            for nb in blob_counts:
+                data = rng_data[nb]
+                # per-op timing, min-of-puts (timeit discipline): a co-
+                # tenant burst inflates SOME puts on this shared host;
+                # the fastest op is what the path can actually do
+                best = 1e9
+                for _ in range(n_puts):
+                    t0 = time.perf_counter()
+                    loc = client.put(data)
+                    best = min(best, time.perf_counter() - t0)
+                key = f"put_{nb}b_{variant}{suffix}_mbps"
+                rate = round(len(data) / best / 2**20, 1)
+                out[key] = max(out.get(key, 0.0), rate)
+                assert client.get(loc) == data
+            # GET readahead A/B on the widest object only
+            nb = max(blob_counts)
+            loc = client.put(rng_data[nb])
+            best = 1e9
+            for _ in range(n_puts):
+                t0 = time.perf_counter()
+                client.get(loc)
+                best = min(best, time.perf_counter() - t0)
+            gkey = f"get_{nb}b_{variant}{suffix}_mbps"
+            out[gkey] = max(out.get(gkey, 0.0), round(
+                len(rng_data[nb]) / best / 2**20, 1))
+            if pooled:
+                reuse1, miss1 = pool_ctrs()
+                pool_hits += reuse1 - reuse0
+                pool_misses += miss1 - miss0
+
+        # 2 interleaved passes per regime, best-of per config: a co-tenant
+        # burst on this shared host must not masquerade as (or mask) a
+        # pipeline effect
+        from chubaofs_tpu import chaos
+
+        suffixes = ("",) if wire_ms <= 0 else ("", "_wire")
+        for suffix in suffixes:
+            if suffix:
+                # deterministic emulated gateway->blobnode RTT on every
+                # shard read/write — the deployment's latency shape
+                chaos.arm("access.write_shard", f"delay({wire_ms / 1000.0})")
+                chaos.arm("access.read_shard", f"delay({wire_ms / 1000.0})")
+            try:
+                for _ in range(2):
+                    for pooled, window in variants:
+                        one_variant(pooled, window, suffix)
+            finally:
+                if suffix:
+                    chaos.disarm("access.write_shard")
+                    chaos.disarm("access.read_shard")
+        for k in sorted(out):
+            log(f"  put-pipeline {k} = {out[k]}")
+        out["rpc_pool_hit_rate"] = round(
+            pool_hits / max(1.0, pool_hits + pool_misses), 3)
+        nb = max(blob_counts)
+        for suffix in suffixes:
+            out[f"put_pipeline_speedup{suffix}"] = round(
+                out[f"put_{nb}b_pipe_pooled{suffix}_mbps"]
+                / max(0.001, out[f"put_{nb}b_serial_nopool{suffix}_mbps"]), 2)
+        ov = exporter.registry("access").summary("put_overlap_ratio").snapshot()
+        out["put_overlap_ratio_avg"] = round(
+            ov["sum"] / ov["count"], 2) if ov["count"] else 0.0
+        log(f"  put-pipeline speedup({nb}b) x{out['put_pipeline_speedup']} raw"
+            + (f" / x{out['put_pipeline_speedup_wire']} wire" if wire_ms > 0
+               else "")
+            + f", overlap {out['put_overlap_ratio_avg']}, "
+              f"pool hit rate {out['rpc_pool_hit_rate']}")
+    finally:
+        gw.stop()
+        c.close()
+    return out
+
+
 def run(root: str, n_files: int = 600, n_clients: int = 4,
         stream_mb: int = 64, metanodes: int = 3, datanodes: int = 3) -> dict:
     from chubaofs_tpu.testing.harness import ProcCluster
@@ -246,6 +375,9 @@ def run(root: str, n_files: int = 600, n_clients: int = 4,
     cfg: dict = {}
     log("raft commit (group-commit microbench)...")
     cfg.update(bench_raft_commit(os.path.join(root, "raftbench"), n_ops=n_files))
+    log("blobstore data-path pipeline (PUT overlap + pooled RPC A/B)...")
+    cfg.update(bench_put_pipeline(os.path.join(root, "blobbench"),
+                                  n_puts=max(3, min(8, n_files // 100))))
 
     cluster = ProcCluster(root, masters=1, metanodes=metanodes,
                           datanodes=datanodes)

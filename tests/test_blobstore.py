@@ -473,14 +473,17 @@ def test_balancer_moves_unit_to_fresh_disks(tmp_path, rng):
         assert c.scheduler.check_balance(min_gap=1) is None
 
         src_disk = task.disk_id
+        chunks_before = c.cm.disks[src_disk].chunk_count
         while c.worker.run_once():
             pass
         assert c.scheduler.tasks(KIND_BALANCE)[0].state == TASK_FINISHED
-        # the unit left the overloaded disk for an emptier one...
+        # the unit left the overloaded disk for an emptier one... (the disk
+        # may still hold OTHER volumes' chunks: the proxy grants a rotating
+        # set of active volumes, and one balance task moves one unit)
         vol = c.cm.get_volume(task.vid)
         assert all(u.disk_id != src_disk for u in vol.units) or \
             sum(1 for u in vol.units if u.disk_id == src_disk) < 2
-        assert c.cm.disks[src_disk].chunk_count == 0
+        assert c.cm.disks[src_disk].chunk_count < chunks_before
         # ...no two units of the volume share a disk, and data reads clean
         assert len({u.disk_id for u in vol.units}) == len(vol.units)
         for loc in locs:

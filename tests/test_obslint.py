@@ -40,6 +40,22 @@ def test_flags_adhoc_stats_dict():
     assert len(findings) == 1 and "ad-hoc stats dict" in findings[0]
 
 
+def test_flags_direct_httpconnection_outside_pool():
+    src = textwrap.dedent("""
+        import http.client
+        def f(host):
+            return http.client.HTTPConnection(host, timeout=5)
+    """)
+    findings = obslint.lint_source(src, "somewhere/client.py")
+    assert len(findings) == 1 and "rpc/pool.py" in findings[0]
+    # the pool itself is the one allowed constructor
+    assert obslint.lint_source(src, "rpc/pool.py") == []
+    # bare-name import form is caught too
+    bare = ("from http.client import HTTPConnection\n"
+            "def f(h):\n    return HTTPConnection(h)\n")
+    assert len(obslint.lint_source(bare, "x.py")) == 1
+
+
 def test_allows_legacy_views_and_bounded_labels():
     legacy = 'class A:\n    def __init__(self):\n        self.stats = {"batches": 0}\n'
     assert obslint.lint_source(legacy, "codec/service.py") == []

@@ -27,6 +27,24 @@ def test_raft_commit_microbench_floor(tmp_path):
     assert out["raft_commit_batch_8p"] > 1.0, out
 
 
+def test_put_pipeline_bench_smoke_floor(tmp_path):
+    """Tier-1 pipeline gate (ISSUE 4 satellite): the data-path A/B bench at
+    smoke size must run end-to-end and report a NONZERO realized overlap
+    ratio (the pipelined PUT really had >1 stripe in flight) plus a sane
+    pool hit rate. Throughput floors stay out of tier-1 — this 2-vCPU CI
+    host's co-tenant noise would make them flaky; PERF.md carries the
+    measured A/B table."""
+    from chubaofs_tpu.tools.perfbench import bench_put_pipeline
+
+    out = bench_put_pipeline(str(tmp_path), blob_kb=16, n_puts=2,
+                             blob_counts=(1, 4), wire_ms=0)
+    assert out["put_overlap_ratio_avg"] > 0, out
+    assert out["rpc_pool_hit_rate"] > 0.5, out
+    for k in ("put_4b_pipe_pooled_mbps", "put_4b_serial_nopool_mbps",
+              "get_4b_pipe_pooled_mbps", "put_pipeline_speedup"):
+        assert out[k] > 0, (k, out)
+
+
 @pytest.mark.slow
 def test_perfbench_tool_runs_and_gates(tmp_path):
     # own session so a timeout kill reaps the 7 daemon GRANDCHILDREN too —
@@ -39,7 +57,9 @@ def test_perfbench_tool_runs_and_gates(tmp_path):
         stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
         start_new_session=True)
     try:
-        stdout, stderr = p.communicate(timeout=420)
+        # budget covers the raft microbench + the data-path pipeline A/B
+        # (ISSUE 4) + the ProcCluster md/stream/smallfile phases
+        stdout, stderr = p.communicate(timeout=540)
     finally:
         try:
             os.killpg(p.pid, signal.SIGKILL)  # idempotent sweep
@@ -63,3 +83,8 @@ def test_perfbench_tool_runs_and_gates(tmp_path):
     assert cfg["raft_commit_ops_8x8"] > 120, cfg
     # batching must actually form batches at 64 concurrent proposers
     assert cfg["raft_commit_batch_64p"] > 1.0, cfg
+    # data-path pipeline A/B ran and the pool held its steady-state hits
+    # (speedup floors live in PERF.md, not CI — co-tenant noise)
+    assert cfg["put_overlap_ratio_avg"] > 0, cfg
+    assert cfg["rpc_pool_hit_rate"] > 0.9, cfg
+    assert cfg["put_pipeline_speedup_wire"] > 0, cfg

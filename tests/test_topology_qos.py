@@ -240,15 +240,17 @@ def test_proxy_alloc_grant_expires(tmp_path):
     cm = ClusterMgr()
     for d in range(10):
         cm.register_disk(d, node_id=d)
-    proxy = Proxy(cm, alloc_ttl=0.05)
+    # active_vols=1: this test pins the TTL-renewal path; the rotating
+    # multi-volume grant set has its own coverage (pipeline tests)
+    proxy = Proxy(cm, alloc_ttl=0.05, active_vols=1)
     mode = int(CodeMode.EC6P3)
     v1 = proxy.alloc_volume(mode)
     assert proxy.alloc_volume(mode).vid == v1.vid  # cached
     # emulate the RPC boundary: the proxy's grant is a SNAPSHOT, not the
     # live clustermgr object (in-process they alias, which would let the
     # status check mask the TTL path under test)
-    vol, exp = proxy._cached[mode]
-    proxy._cached[mode] = (copy.deepcopy(vol), exp)
+    vols, exp = proxy._cached[mode]
+    proxy._cached[mode] = (copy.deepcopy(vols), exp)
     cm.set_volume_status(v1.vid, "idle")  # retired behind the proxy's back
     # before the TTL the stale grant is still served (cache semantics)...
     assert proxy.alloc_volume(mode).vid == v1.vid
