@@ -162,29 +162,70 @@ def bench_lrc_encode(rng, dev, batch) -> float:
     return batch * t.N * k / per / 1e9
 
 
+# the probe child prints a marker after each phase it SURVIVES, so a failure
+# names the phase it died in (import hang vs backend-init hang vs no devices)
+# instead of a bare rc=2 — two consecutive undiagnosable rounds motivated this
+_PROBE_SRC = (
+    "import sys\n"
+    "print('stage:python_up', flush=True)\n"
+    "import jax\n"
+    "print('stage:jax_imported', flush=True)\n"
+    "ds = jax.devices()\n"
+    "print('stage:devices_ok %d %s' % (len(ds), ds[0].platform if ds else '-'),"
+    " flush=True)\n"
+)
+# last marker seen -> the phase the probe died IN
+_PROBE_NEXT_PHASE = {
+    None: "python_spawn",
+    "stage:python_up": "import_jax",
+    "stage:jax_imported": "backend_init_list_devices",
+    # every stage passed yet the child still died: teardown (a plugin
+    # crashing at interpreter exit), not an init phase
+    "stage:devices_ok": "child_teardown",
+}
+
+
 def _resolve_device(timeout_s: float = 120.0):
     """jax.devices() with a watchdog: a wedged TPU tunnel hangs backend init
     FOREVER (observed: the axon plugin blocks even platform listing), which
     would hang the whole bench run. The probe runs in a SUBPROCESS (a hung
     plugin can hold the GIL, so an in-process watchdog thread may never get
     scheduled to time out); only after it succeeds is the backend initialized
-    here. Fail fast with a diagnosable JSON line instead of hanging."""
+    here. On failure the single JSON line carries a staged diagnosis — which
+    probe phase died, the exact command, its timing, rc and stderr tail — so
+    a dead round is attributable from the BENCH json alone."""
     import subprocess
 
+    cmd = [sys.executable, "-c", _PROBE_SRC]
+    t0 = time.monotonic()
     try:
-        subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            capture_output=True, timeout=timeout_s, check=True)
+        subprocess.run(cmd, capture_output=True, timeout=timeout_s, check=True)
     except Exception as e:  # timeout or nonzero exit: backend unusable
-        err = (f"TPU backend probe failed: {type(e).__name__}"
-               + (" (tunnel down?)"
-                  if isinstance(e, subprocess.TimeoutExpired) else ""))
-        stderr = getattr(e, "stderr", b"") or b""
+        elapsed = time.monotonic() - t0
+        stdout = (getattr(e, "stdout", b"") or b"").decode("utf-8", "replace")
+        stderr = (getattr(e, "stderr", b"") or b"").decode("utf-8", "replace")
+        markers = [ln.strip() for ln in stdout.splitlines()
+                   if ln.startswith("stage:")]
+        last = markers[-1].split(" ", 1)[0] if markers else None
+        failed_in = _PROBE_NEXT_PHASE.get(last, "unknown")
+        timed_out = isinstance(e, subprocess.TimeoutExpired)
+        err = (f"TPU backend probe failed in {failed_in}: {type(e).__name__}"
+               + (" (tunnel down?)" if timed_out else ""))
         if stderr:  # the child's traceback tells dead-tunnel from broken-install
-            log(stderr.decode("utf-8", "replace")[-2000:])
+            log(stderr[-2000:])
         print(json.dumps({
             "metric": HEADLINE_METRIC, "value": 0.0,
             "unit": "GB/s", "vs_baseline": 0.0, "error": err,
+            "probe": {
+                "failed_in": failed_in,
+                "stages_reached": markers,
+                "cmd": cmd,
+                "elapsed_s": round(elapsed, 3),
+                "timeout_s": timeout_s,
+                "timed_out": timed_out,
+                "rc": getattr(e, "returncode", None),
+                "stderr_tail": stderr[-1500:],
+            },
         }))
         sys.exit(2)
     return jax.devices()[0]

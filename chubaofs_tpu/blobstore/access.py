@@ -294,8 +294,12 @@ class Access:
                                err=type(err).__name__ if err else "")
 
     def _put(self, data: bytes, code_mode: CodeMode | int | None = None) -> Location:
+        from chubaofs_tpu.blobstore import trace
+
         if not data:
             raise AccessError("empty put")
+        span = trace.current_span()
+        t_prep = time.perf_counter()
         mode = (
             int(code_mode)
             if code_mode is not None
@@ -305,7 +309,12 @@ class Access:
 
         blobs = [data[i : i + self.max_blob_size]
                  for i in range(0, len(data), self.max_blob_size)]
+        if span is not None:  # crc + blob split: the host-prepare stage
+            span.add_stage("prepare", start=t_prep)
+        t_alloc = time.perf_counter()
         first_bid, _ = self._alloc_breaker.call(self.proxy.alloc_bids, len(blobs))
+        if span is not None:
+            span.add_stage("alloc", start=t_alloc)
         t = get_tactic(mode)
         window = int(self.pipeline_window)
         if window >= 1 and len(blobs) > 1:
@@ -371,6 +380,7 @@ class Access:
             vol = self._alloc_breaker.call(self.proxy.alloc_volume, mode)
             if span is not None:
                 span.append_track_log("proxy", start=t_alloc)
+                span.add_stage("alloc", start=t_alloc)
             futures.append(self._encode_blob(t, blob))
             metas.append((first_bid + i, vol, len(blob)))
 
@@ -380,6 +390,10 @@ class Access:
             stripe = fut.result()  # (total, shard_len), locals included
             if span is not None:
                 span.append_track_log("codec", start=t_enc)
+                # wait-for-stripe: codec queue + device batch, as the PUT
+                # experiences it (the codec side adds its own host/device
+                # sub-stages to the same span)
+                span.add_stage("encode", start=t_enc)
             vol = self._write_blob(t, mode, vol, bid, stripe)
             out.append(Blob(bid=bid, vid=vol.vid, size=size))
         return out
@@ -398,6 +412,9 @@ class Access:
         from chubaofs_tpu.blobstore import trace
 
         span = trace.current_span()
+        if span is not None:  # pipeline shape rides the span record
+            span.set_tag("pipeline_window", window)
+            span.set_tag("encode_ahead", self.encode_ahead)
         reg = registry("access")
         occ = reg.summary("put_pipeline_occupancy", buckets=BATCH_BUCKETS)
         abort = threading.Event()
@@ -416,6 +433,9 @@ class Access:
                 stripe = enc_fut.result()
                 if span is not None:
                     span.append_track_log("codec", start=t_enc)
+                    # encode-ahead wait as THIS stage saw it (queue depth
+                    # already bought most of it during older blobs' writes)
+                    span.add_stage("encode", start=t_enc)
                 if abort.is_set():
                     raise _PipelineAborted()
                 t_w = time.perf_counter()
@@ -472,6 +492,7 @@ class Access:
                 vol = self._alloc_breaker.call(self.proxy.alloc_volume, mode)
                 if span is not None:
                     span.append_track_log("proxy", start=t_alloc)
+                    span.add_stage("alloc", start=t_alloc)
                 inflight.append(
                     (i, self._pipe_pool.submit(stage, i, enc_futs.pop(i), vol,
                                                first_bid + i)))
@@ -564,6 +585,7 @@ class Access:
                 results.append(TimeoutError("stripe write deadline"))
         if span is not None:
             span.append_track_log("blobnode", start=t_hop)
+            span.add_stage("write", start=t_hop)  # whole shard fan-out
         ok = {i for i, r in zip(range(t.total), results) if r is None}
         failed = sorted(set(range(t.total)) - ok)
         # quorum counts global-stripe shards only (stream_put.go:226,362:
@@ -632,6 +654,10 @@ class Access:
                                err=type(err).__name__ if err else "")
 
     def _get(self, loc: Location | str, offset: int = 0, size: int | None = None) -> bytes:
+        from chubaofs_tpu.blobstore import trace
+
+        span = trace.current_span()
+        t_prep = time.perf_counter()
         if isinstance(loc, str):
             loc = Location.from_json(loc)
         self._check_sig(loc)
@@ -650,9 +676,14 @@ class Access:
             lo = max(0, offset - blob_start)
             hi = min(blob.size, offset + size - blob_start)
             segs.append((blob, lo, hi - lo))
+        if span is not None:  # location parse + sig check + range plan
+            span.add_stage("prepare", start=t_prep)
         window = int(self.pipeline_window)
         if len(segs) > 1 and window >= 1:
             return self._get_readahead(loc.code_mode, segs, window)
+        if len(segs) == 1:  # whole-blob/single-blob GET: no reassembly copy
+            blob, lo, n = segs[0]
+            return self._read_blob(loc.code_mode, blob, lo, n)
         out = bytearray()
         for blob, lo, n in segs:
             out += self._read_blob(loc.code_mode, blob, lo, n)
@@ -736,7 +767,12 @@ class Access:
         if span is not None:
             span.append_track_log("blobnode", start=t_hop)
         if all(p is not None for p in pieces):
-            return b"".join(pieces)
+            data = b"".join(pieces)
+            if span is not None:  # fan-out + reassembly: the read stage
+                span.add_stage("read", start=t_hop)
+            return data
+        if span is not None:
+            span.add_stage("read", start=t_hop)  # the failed direct attempt
         for f in futs:  # queued laggards must not hold pool workers
             f.cancel()
         return self._read_blob_degraded(t, vol, blob, shard_len, offset, size,
@@ -821,6 +857,10 @@ class Access:
         the shard-repair topic."""
         from concurrent.futures import FIRST_COMPLETED, wait
 
+        from chubaofs_tpu.blobstore import trace
+
+        span = trace.current_span()
+        t_gather = time.perf_counter()
         total = t.N + t.M
         stripe = np.zeros((total, shard_len), np.uint8)
         present: list[int] = []
@@ -890,6 +930,8 @@ class Access:
                         next_i += 1
         for fut in pending:  # abandon stragglers (queued ones cancel cleanly)
             fut.cancel()
+        if span is not None:
+            span.add_stage("gather", start=t_gather)  # hedged stripe reads
         # the repair plane must hear about everything the gather PROVED
         # damaged — including shards the local-stripe pass then fixes only
         # in memory (they are still broken on disk). Shards the hedge never
@@ -904,7 +946,10 @@ class Access:
             raise AccessError(
                 f"blob {blob.bid}: only {len(present)} shards readable, need {t.N}"
             )
+        t_dec = time.perf_counter()
         fixed = self.codec.reconstruct(t.N, t.M, stripe, missing, data_only=True).result()
+        if span is not None:
+            span.add_stage("decode", start=t_dec)  # on-the-fly reconstruct
         self.proxy.send_shard_repair(vol.vid, blob.bid, damaged, "get_miss")
         unprobed = [i for i in range(total)
                     if i not in present and i not in failed]

@@ -13,6 +13,15 @@ way; the packet TCP wire carries the same two fields in its arg blob
 Track logs are BOUNDED: at most TRACK_MAX entries per span (a failpoint-looped
 fan-out must not blow the response-header budget), and module names are
 sanitized (`;`/newlines/`:` would corrupt the ';'-joined wire form).
+
+Beyond the wire-form track log, every span is a STRUCTURED record: a span id,
+its parent (in-process parent span, or the remote caller's span id carried
+next to the trace id), a wall-clock start stamp plus monotonic duration, and
+named STAGES — (name, offset, duration) attributions inside the span
+(encode device time, raft commit wait, pool checkout...) that the
+critical-path analyzer (tools/cfstrace.py) projects onto the request's wall
+time. `finish()` hands the span to the trace sink (utils/tracesink.py) when
+one is installed; with no sink the hook is a single None check.
 """
 
 from __future__ import annotations
@@ -23,11 +32,26 @@ import uuid
 
 TRACE_ID_KEY = "Trace-Id"
 TRACK_LOG_KEY = "Trace-Tracklog"
+SPAN_ID_KEY = "Trace-Span-Id"
 
 # hard cap on track entries per span: deep fan-outs degrade to a truncated
 # track log, never to an unbounded response header
 TRACK_MAX = 64
+# stage attributions are richer than track entries but just as bounded: a
+# retry-looped hop must not grow a span record without limit
+STAGE_MAX = 128
 _ENTRY_MAX = 128  # one hostile module name must not be the whole header
+
+# sink hook installed by utils/tracesink (None = tracing-only, zero
+# persistence work); called with the finished span, must never raise
+_finish_hook = None
+
+
+def set_finish_hook(fn) -> None:
+    """Install (or clear, with None) the span-finish hook the trace sink
+    rides. Process-global, like the span machinery itself."""
+    global _finish_hook
+    _finish_hook = fn
 
 _local = threading.local()
 
@@ -53,10 +77,21 @@ class Span:
         self._trace_id = trace_id or (parent.trace_id if parent else None)
         self.parent = parent
         self.start = time.perf_counter()
+        # wall stamp pairs records from different processes onto one
+        # timeline (same-host skew only); NEVER used for durations — those
+        # stay on the monotonic clock
+        self.start_wall = time.time()
         self.tags: dict[str, object] = {}
         self.logs: list[tuple[float, str]] = []
         self.track: list[str] = []  # track-log entries, e.g. "blobnode:12"
         self.track_dropped = 0  # entries the TRACK_MAX cap swallowed
+        # named in-span attributions: (name, offset_s from start, dur_s)
+        self.stages: list[tuple[str, float, float]] = []
+        self.stage_dropped = 0
+        # span id of the remote CALLER's span when this span continued a
+        # carrier that named one (the cross-process parent edge)
+        self.remote_parent: str | None = None
+        self._span_id: str | None = None
         self.finished_us: int | None = None
 
     @property
@@ -64,6 +99,13 @@ class Span:
         if self._trace_id is None:
             self._trace_id = uuid.uuid4().hex[:16]
         return self._trace_id
+
+    @property
+    def span_id(self) -> str:
+        # lazy like trace_id: minted only when someone records/propagates it
+        if self._span_id is None:
+            self._span_id = uuid.uuid4().hex[:16]
+        return self._span_id
 
     # -- opentracing-style surface ---------------------------------------------
     def set_tag(self, k: str, v) -> "Span":
@@ -75,9 +117,29 @@ class Span:
 
     def _push_track(self, entry: str):
         if len(self.track) >= TRACK_MAX:
+            if self.track_dropped == 0:
+                # first drop on this span: count it (cold path — truncation
+                # is the anomaly the counter exists to surface)
+                try:
+                    from chubaofs_tpu.utils.exporter import registry
+
+                    registry("trace").counter("track_truncated").add()
+                except Exception:
+                    pass
             self.track_dropped += 1
             return
         self.track.append(entry)
+
+    def add_stage(self, name: str, start: float, dur: float | None = None):
+        """Attribute a named stage of this span: `start` is a
+        time.perf_counter() stamp (any thread — one global clock), `dur`
+        seconds (elapsed-since-start when omitted). Bounded by STAGE_MAX."""
+        if dur is None:
+            dur = time.perf_counter() - start
+        if len(self.stages) >= STAGE_MAX:
+            self.stage_dropped += 1
+            return
+        self.stages.append((sanitize_module(name), start - self.start, dur))
 
     def append_track_log(self, module: str, start: float | None = None,
                          err: Exception | None = None):
@@ -108,6 +170,12 @@ class Span:
                 for e in self.track:
                     self.parent._push_track(e)
                 self.parent.track_dropped += self.track_dropped
+            hook = _finish_hook
+            if hook is not None:
+                try:
+                    hook(self)
+                except Exception:
+                    pass  # a sink failure must never fail the traced op
 
     def __enter__(self):
         push_span(self)
@@ -119,17 +187,54 @@ class Span:
         return False
 
     # -- propagation -----------------------------------------------------------
+    def track_entries(self) -> list[str]:
+        """Track entries as they go on the wire (always a fresh list — a
+        caller may attach it to a reply that outlives this span's next
+        append): a dropped-entry count is no longer silent — the
+        `...truncated:<n>` sentinel rides in-band so a reader knows the log
+        is a prefix, not the whole story."""
+        if self.track_dropped:
+            return self.track + [f"...truncated:{self.track_dropped}"]
+        return list(self.track)
+
     def inject(self, carrier: dict):
         carrier[TRACE_ID_KEY] = self.trace_id
+        carrier[SPAN_ID_KEY] = self.span_id
         if self.track:
-            carrier[TRACK_LOG_KEY] = ";".join(self.track)
+            carrier[TRACK_LOG_KEY] = ";".join(self.track_entries())
 
     def track_log_string(self) -> str:
-        return ";".join(self.track)
+        return ";".join(self.track_entries())
 
     def modules(self) -> set[str]:
         """Distinct module names present in the track log."""
         return {e.split(":", 1)[0] for e in self.track if e}
+
+    def to_record(self) -> dict:
+        """The span as a JSON-able SpanRecord — what the trace sink persists
+        and /traces serves; tools/cfstrace.py reassembles trees from these."""
+        dur = self.finished_us
+        if dur is None:  # unfinished span recorded early (best effort)
+            dur = int((time.perf_counter() - self.start) * 1e6)
+        rec: dict = {
+            "trace_id": self.trace_id,
+            "span_id": self.span_id,
+            "parent_span_id": (self.parent.span_id if self.parent is not None
+                               else self.remote_parent),
+            "op": self.operation,
+            "start": round(self.start_wall, 6),
+            "dur_us": dur,
+        }
+        if self.stages:
+            rec["stages"] = [[n, int(off * 1e6), int(d * 1e6)]
+                             for n, off, d in self.stages]
+        if self.stage_dropped:
+            rec["stages_dropped"] = self.stage_dropped
+        if self.tags:
+            rec["tags"] = dict(self.tags)
+        if self.track:
+            rec["track"] = self.track_log_string()
+        return rec
 
 
 def extract_trace_id(carrier: dict | None) -> str | None:
@@ -140,10 +245,18 @@ def extract_trace_id(carrier: dict | None) -> str | None:
     return carrier.get(TRACE_ID_KEY) or carrier.get(TRACE_ID_KEY.lower())
 
 
+def extract_span_id(carrier: dict | None) -> str | None:
+    """The remote caller's span id, same lower-case tolerance."""
+    if not carrier:
+        return None
+    return carrier.get(SPAN_ID_KEY) or carrier.get(SPAN_ID_KEY.lower())
+
+
 def start_span(operation: str, carrier: dict | None = None) -> Span:
     """New root (or remote-continued, when carrier holds a trace id) span."""
     span = Span(operation, trace_id=extract_trace_id(carrier))
     if carrier:
+        span.remote_parent = extract_span_id(carrier)
         tl = carrier.get(TRACK_LOG_KEY) or carrier.get(TRACK_LOG_KEY.lower())
         if tl:
             span.merge_track(tl)

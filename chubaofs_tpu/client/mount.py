@@ -101,7 +101,7 @@ class Mount:
     # -- caches ----------------------------------------------------------------
 
     def _resolve(self, path: str) -> int:
-        now = time.time()
+        now = time.monotonic()  # cache TTLs are deltas, never wall stamps
         hit = self._lookups.get(path)
         if hit and now < hit[0]:
             return hit[1]
@@ -112,7 +112,7 @@ class Mount:
     def _stat_ino(self, ino: int) -> dict:
         from chubaofs_tpu.meta.metanode import OpError
 
-        now = time.time()
+        now = time.monotonic()
         hit = self._attr.get(ino)
         if hit and now < hit[0]:
             return hit[1]

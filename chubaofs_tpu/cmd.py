@@ -308,14 +308,14 @@ class MasterDaemon(_Daemon):
 
         candidates = list(dict.fromkeys(peers))
         raft_addrs = self._raft_addrs(list(set(peers) | {node_id}))
-        deadline = time.time() + 20
+        deadline = time.monotonic() + 20
         last = "no peers reachable"
 
         def note_hint(hint):
             if isinstance(hint, int) and hint not in candidates:
                 candidates.append(hint)
 
-        while time.time() < deadline:
+        while time.monotonic() < deadline:
             for peer in list(candidates):
                 node = self.sm.nodes.get(peer)
                 if node is None or not node.addr:
@@ -687,7 +687,7 @@ class _MasterUserStore:
         self._cache: dict[str, tuple[float, dict | None]] = {}
 
     def get(self, ak: str):
-        now = time.time()
+        now = time.monotonic()  # TTL math, never a cross-process timestamp
         hit = self._cache.get(ak)
         if hit is not None and now < hit[0]:
             return hit[1]

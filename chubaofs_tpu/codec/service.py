@@ -67,6 +67,10 @@ class _Job:
     future: Future = field(default_factory=Future)
     # matmul jobs carry their GF matrix (repair rows x survivors)
     mat: np.ndarray | None = None
+    # the SUBMITTER's trace span (if any): the dispatcher attributes its
+    # batch's host/device time back onto it as named stages, so a PUT's
+    # critical-path report splits encode wait into host-ms vs device-ms
+    span: object | None = None
 
 
 def _pad_to_bucket(data: np.ndarray, k: int, kb: int) -> np.ndarray:
@@ -218,6 +222,9 @@ class CodecService:
     # -- dispatcher --------------------------------------------------------
 
     def _submit(self, job: _Job):
+        from chubaofs_tpu.blobstore import trace
+
+        job.span = trace.current_span()
         self._ensure_started()
         self._q.put(job)
 
@@ -312,6 +319,7 @@ class CodecService:
         t0 = _time.perf_counter()
         # jobs arrive pre-padded to the bucket: stacking is the whole job here
         stack = np.stack([j.data for j in jobs])
+        t_dev = _time.perf_counter()
         # both paths go through the host-boundary grouped entry: batches of
         # stripes are viewed (free numpy reshape) as MXU-row-filling groups
         # before they ever reach the device (rs.gf_matmul_hostbatch) — or,
@@ -325,7 +333,17 @@ class CodecService:
             from chubaofs_tpu.ops import bitmatrix
 
             out = mm(bitmatrix.expand_matrix(jobs[0].mat).astype(np.int8), stack)
-        self._record_batch(len(jobs), _time.perf_counter() - t0)
+        t_done = _time.perf_counter()
+        self._record_batch(len(jobs), t_done - t0)
+        for j in jobs:
+            if j.span is not None:
+                # the BATCH's wall intervals, attributed to every rider: the
+                # job was on the host/device during exactly these windows
+                # (shared across the batch — sums can exceed device seconds,
+                # wall-clock union cannot)
+                j.span.add_stage("codec.host", start=t0, dur=t_dev - t0)
+                j.span.add_stage("codec.device", start=t_dev,
+                                 dur=t_done - t_dev)
         for i, j in enumerate(jobs):
             j.future.set_result(out[i, :, : j.k])
 

@@ -150,8 +150,71 @@ class Console:
             return Response(200, {"Content-Type": "text/plain"},
                             "".join(sections).encode())
 
+        def fetch_json(addr: str, path: str) -> dict | None:
+            from chubaofs_tpu.tools.cfsstat import scrape
+
+            try:
+                return json.loads(scrape(addr, path, timeout=3))
+            except Exception:
+                return None  # dead/misconfigured target: skip, keep the rest
+
+        def _fanout(path: str) -> list[tuple[str, dict | None]]:
+            from concurrent.futures import ThreadPoolExecutor
+
+            targets = self.master_addrs + self.metrics_addrs
+            with ThreadPoolExecutor(max_workers=min(8, len(targets) or 1)) as pool:
+                return list(zip(targets,
+                                pool.map(lambda a: fetch_json(a, path),
+                                         targets)))
+
+        def trace_rollup(req: Request):
+            """The collector: fetch one trace id's span records from EVERY
+            known daemon's /traces side-door and reassemble them into one
+            span set (deduped by span id) — the cross-process hop tree
+            `cfs-trace` renders. Unreachable targets are reported, not
+            fatal: a partial tree still explains most of the request."""
+            tid = req.q("id")
+            if not tid:
+                return Response.json({"error": "missing ?id=<trace-id>"},
+                                     status=400)
+            import urllib.parse
+
+            spans: dict[str, dict] = {}
+            reached, missed = [], []
+            # re-encode the id: a raw space/control char would make every
+            # upstream request invalid and misreport the cluster as dark
+            for addr, out in _fanout(f"/traces?id={urllib.parse.quote(tid)}"):
+                if out is None:
+                    missed.append(addr)
+                    continue
+                reached.append(addr)
+                for rec in out.get("spans", ()):
+                    if rec.get("span_id"):
+                        spans.setdefault(rec["span_id"], rec)
+            return Response.json(
+                {"trace_id": tid, "targets": reached, "unreachable": missed,
+                 "spans": sorted(spans.values(),
+                                 key=lambda r: r.get("start", 0.0))})
+
+        def slowops_rollup(req: Request):
+            """Recent slow-op audit entries from every daemon, each tagged
+            with its source target — what `cfs-stat --slowops` renders next
+            to the metric diff."""
+            entries = []
+            missed = []
+            for addr, out in _fanout("/slowops"):
+                if out is None:
+                    missed.append(addr)
+                    continue
+                for rec in out.get("slowops", ()):
+                    entries.append({**rec, "target": addr})
+            entries.sort(key=lambda e: e.get("ts", ""))
+            return Response.json({"slowops": entries, "unreachable": missed})
+
         r.get("/api/overview", overview)
         r.get("/api/metrics", metrics_rollup)
+        r.get("/api/trace", trace_rollup)
+        r.get("/api/slowops", slowops_rollup)
         r.post("/graphql", graphql_proxy)
         return r
 

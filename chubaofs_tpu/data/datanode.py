@@ -382,6 +382,7 @@ class DataNode:
         span = trace.current_span()
         if span is not None:  # waiter-side raft hop entry (commit wait)
             span.append_track_log("raft", start=t_wait)
+            span.add_stage("raft", start=t_wait)
         if status != "ok":
             return pkt.reply(RES_ERR, arg={"error": detail})
         return pkt.reply()

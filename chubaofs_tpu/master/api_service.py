@@ -91,10 +91,27 @@ class MasterAPI:
         g("/user/akInfo", self._w(self.user_ak_info, leader=False))
         g("/user/updatePolicy", self._w(self.user_update_policy, admin=True))
         g("/user/list", self._w(self.user_list, leader=False))
+        # recent slow-op audit of THIS master process (the RPCServer mounts
+        # the same data at /slowops on every daemon; this alias keeps the
+        # master's ops surface under its /api namespace for cfs-stat)
+        g("/api/slowops", self.slowops)
         from chubaofs_tpu.master.gapi import GraphQLAPI
 
         r.post("/graphql", GraphQLAPI(self.master).handle)
         return r
+
+    def slowops(self, req: Request):
+        from chubaofs_tpu.utils.auditlog import recent_slowops
+
+        # QoS-gated like every /api route (each request re-reads the slowop
+        # rotor from disk — a polling loop must not hammer the master
+        # unthrottled), but WITHOUT the envelope: the response shape matches
+        # the daemon-side /slowops side-door so cfs-stat and the console
+        # rollup parse both identically
+        if not self.qos.allow(req.path):
+            return Response.json({"slowops": [],
+                                  "error": "rate limit exceeded"}, status=429)
+        return Response.json({"slowops": recent_slowops(req.q_int("n", 100))})
 
     def _w(self, fn, leader: bool = True, admin: bool = False,
            cap: str = "admin"):

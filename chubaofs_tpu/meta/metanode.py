@@ -109,6 +109,7 @@ class MetaNode:
             # raft-layer done-callback would race this thread's reply
             # construction/span.finish and lose the entry
             span.append_track_log("raft", start=t_wait)
+            span.add_stage("raft", start=t_wait)  # group-commit wait
             # in-process callers get their "metanode" hop entry here; under
             # a MetaService handler the SERVICE span already appends one
             # covering the whole dispatch — one entry per hop either way

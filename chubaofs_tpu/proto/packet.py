@@ -161,9 +161,12 @@ class Packet:
 # -- trace carrier on the packet wire ------------------------------------------
 # The binary header is fixed; the trace id and returning track log ride the
 # JSON arg blob under reserved keys (the reference packs follower addrs into
-# its arg bytes the same way). Requests carry "_trace"; replies carry "_track".
+# its arg bytes the same way). Requests carry "_trace" (+ the caller's span
+# id under "_span", so the server span records its cross-process parent);
+# replies carry "_track".
 
 TRACE_ARG_KEY = "_trace"
+SPAN_ARG_KEY = "_span"
 TRACK_ARG_KEY = "_track"
 
 
@@ -174,6 +177,7 @@ def trace_inject(pkt: "Packet") -> "Packet":
     span = trace.current_span()
     if span is not None:
         pkt.arg[TRACE_ARG_KEY] = span.trace_id
+        pkt.arg[SPAN_ARG_KEY] = span.span_id
     return pkt
 
 
@@ -182,13 +186,16 @@ def trace_extract(pkt: "Packet", operation: str):
     from chubaofs_tpu.blobstore import trace
 
     tid = pkt.arg.get(TRACE_ARG_KEY) if isinstance(pkt.arg, dict) else None
-    return trace.Span(operation, trace_id=tid)
+    span = trace.Span(operation, trace_id=tid)
+    if tid is not None:
+        span.remote_parent = pkt.arg.get(SPAN_ARG_KEY)
+    return span
 
 
 def trace_reply(resp: "Packet", span) -> "Packet":
     """Attach the server span's track log to an outgoing reply."""
     if span is not None and span.track:
-        resp.arg[TRACK_ARG_KEY] = list(span.track)
+        resp.arg[TRACK_ARG_KEY] = span.track_entries()
     return resp
 
 

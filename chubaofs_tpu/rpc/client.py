@@ -62,12 +62,14 @@ class RPCClient:
             hdrs[AUTH_HEADER] = sign_path(self.auth_secret, plain)
         if crc and body:
             hdrs[CRC_HEADER] = str(zlib.crc32(body) & 0xFFFFFFFF)
-        # cross-hop tracing: the caller's span id rides the request headers;
+        # cross-hop tracing: the caller's trace + span ids ride the request
+        # headers (the span id is the server span's cross-process parent);
         # the server's track log rides back on the response and folds into
         # the same span (blobstore/common/trace's header carrier)
         span = trace.current_span()
         if span is not None:
             hdrs.setdefault(trace.TRACE_ID_KEY, span.trace_id)
+            hdrs.setdefault(trace.SPAN_ID_KEY, span.span_id)
         last: Exception | None = None
         for attempt in range(self.retries):
             host = self._next_host()
@@ -109,8 +111,15 @@ class RPCClient:
         draining to a fresh connect — without consuming a retry attempt.
         Fresh-connection failures propagate to the real retry loop."""
         pool = self.pool
+        span = trace.current_span()
         while True:
+            t_pool = time.perf_counter()
             conn, reused = pool.checkout(host, timeout=self.timeout)
+            if span is not None:
+                # named stages for the critical-path analyzer: connection
+                # checkout (reuse hit or TCP connect) vs time on the wire
+                span.add_stage("rpc.pool", start=t_pool)
+            t_wire = time.perf_counter()
             try:
                 conn.request(method, path, body=body or None, headers=hdrs)
                 resp = conn.getresponse()
@@ -136,6 +145,8 @@ class RPCClient:
                 if method not in self._REPLAYABLE or is_timeout:
                     raise
                 continue
+            if span is not None:
+                span.add_stage("rpc.wire", start=t_wire)
             headers_out = dict(resp.getheaders())
             # body fully read above: the conn is reusable unless the server
             # asked to close (will_close covers Connection: close and EOF-

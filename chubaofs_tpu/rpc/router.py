@@ -29,6 +29,15 @@ class Request:
         v = self.query.get(key)
         return v[0] if v else default
 
+    def q_int(self, key: str, default: int) -> int:
+        """Integer query param; malformed values degrade to the default and
+        negatives clamp to 0 (introspection endpoints must not 500 on a
+        typo'd ?n=, and ?n=-5 must not invert a recency window)."""
+        try:
+            return max(0, int(self.q(key) or default))
+        except ValueError:
+            return default
+
     def has_q(self, key: str) -> bool:
         return key in self.query
 

@@ -79,7 +79,13 @@ class RPCServer:
     `metrics=False` skips the mount — for PUBLIC-facing routers whose
     namespace the route would shadow (the objectnode S3 surface, where
     GET /metrics is a bucket listing and every route is auth-wrapped);
-    such daemons expose a statsListen side-door instead."""
+    such daemons expose a statsListen side-door instead.
+
+    The same flag gates the trace/audit side-doors: `/traces?id=<trace-id>`
+    and `/traces/recent` serve the process trace sink's span records, and
+    `/slowops` the recent slow-op audit entries — so the console collector
+    and `cfs-trace` can fetch one trace's spans from every daemon it
+    crossed with nothing but the addresses `cfs-stat` already scrapes."""
 
     def __init__(self, router: Router, host: str = "127.0.0.1", port: int = 0,
                  registry=None, module: str = "", metrics: bool = True):
@@ -93,8 +99,38 @@ class RPCServer:
             return Response(200, {"Content-Type": "text/plain"},
                             (text + exporter.render_all()).encode())
 
+        def traces_route(r):
+            from chubaofs_tpu.utils import tracesink
+
+            tid = r.q("id")
+            if not tid:
+                return Response(400, {"Content-Type": "application/json"},
+                                b'{"error":"missing ?id=<trace-id>"}')
+            return Response.json(
+                {"trace_id": tid,
+                 "spans": tracesink.default_sink().records(tid)})
+
+        def traces_recent_route(r):
+            from chubaofs_tpu.utils import tracesink
+
+            snk = tracesink.default_sink()
+            return Response.json({"spans": snk.recent_records(r.q_int("n", 200)),
+                                  "traces": snk.recent_traces()})
+
+        def slowops_route(r):
+            from chubaofs_tpu.utils.auditlog import recent_slowops
+
+            return Response.json({"slowops": recent_slowops(r.q_int("n", 100))})
+
         if metrics:
             router.get("/metrics", metrics_route)
+            router.get("/traces", traces_route)
+            router.get("/traces/recent", traces_recent_route)
+            router.get("/slowops", slowops_route)
+            # env-armed sampling goes live at daemon boot, not first scrape
+            from chubaofs_tpu.utils import tracesink
+
+            tracesink.activate_from_env()
 
         outer = self
         self._inflight = 0

@@ -64,7 +64,7 @@ class MetaWrapper:
         re-submitting turns success into EEXIST/ENOENT."""
         RETRYABLE = ("ECONN", "ENOPARTITION") + (("EIO",) if idempotent else ())
 
-        deadline = time.time() + self.RETRY_WINDOW
+        deadline = time.monotonic() + self.RETRY_WINDOW
         last: Exception | None = None
         while True:
             order = [mp.leader] if mp.leader in mp.peers else []
@@ -91,7 +91,7 @@ class MetaWrapper:
                     if e.code not in RETRYABLE:
                         raise
                     last = e
-            if time.time() >= deadline:
+            if time.monotonic() >= deadline:
                 break
             time.sleep(self.RETRY_SLEEP)
         raise last or MasterError(f"partition {mp.partition_id}: no leader reachable")
@@ -297,7 +297,9 @@ class MetaWrapper:
                 res = (dd.ino, self.unlink_inode(dd.ino).nlink)
             displaced = (res[0], res[1], dst_is_dir)
         tx_id = f"tx-{self.client_id}-{uuid.uuid4().hex[:12]}"
-        deadline = time.time() + self.TX_TTL
+        # the tx deadline rides the proposal and is compared by every
+        # replica's sweep (now=time.time()) — cross-process wall time
+        deadline = time.time() + self.TX_TTL  # wallclock: protocol stamp
         tm_pid = dst_mp.partition_id
         plans = [
             (dst_mp, [("create_dentry",

@@ -144,7 +144,7 @@ class ExtentClient:
         last = None
         if hosts is None:
             hosts = dp["hosts"] if retry_hosts else dp["hosts"][:1]
-        deadline = _time.time() + (self.RETRY_WINDOW if retry_hosts else 0)
+        deadline = _time.monotonic() + (self.RETRY_WINDOW if retry_hosts else 0)
         while True:
             for addr in hosts:
                 sock = self.pool.get(addr)
@@ -166,7 +166,7 @@ class ExtentClient:
                     continue
                 trace_merge(reply)
                 return reply
-            if _time.time() >= deadline:
+            if _time.monotonic() >= deadline:
                 break
             _time.sleep(self.RETRY_SLEEP)
         raise last or StreamError("no hosts")

@@ -179,8 +179,8 @@ class ProcCluster:
 
     def _await_leader(self, timeout: float = 30.0):
         mc = self.client_master()
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             try:
                 if mc.get_cluster()["leader_id"] is not None:
                     return
@@ -191,8 +191,8 @@ class ProcCluster:
 
     def _await_listen(self, addr: str, timeout: float = 120.0):
         host, port = addr.rsplit(":", 1)
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             try:
                 with socket.create_connection((host, int(port)), timeout=2):
                     return
@@ -202,8 +202,8 @@ class ProcCluster:
 
     def await_nodes(self, count: int, timeout: float = 30.0):
         mc = self.client_master()
-        deadline = time.time() + timeout
-        while time.time() < deadline:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
             try:
                 nodes = mc.get_cluster()["nodes"]
                 if sum(1 for n in nodes if n["addr"]) >= count:

@@ -98,6 +98,10 @@ def main(argv=None, out=None) -> int:
                    help="only metrics whose name contains this substring")
     p.add_argument("--all", action="store_true",
                    help="include zero-delta metrics")
+    p.add_argument("--slowops", action="store_true",
+                   help="also fetch the daemon's recent slow-op audit "
+                        "entries (/slowops; /api/slowops on a console) and "
+                        "print them next to the diff")
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
 
@@ -111,25 +115,57 @@ def main(argv=None, out=None) -> int:
         print(f"error: {e}", file=sys.stderr)
         return 1
 
+    slowops: list[dict] = []
+    if args.slowops:
+        # /api/slowops first: on a console that's the cluster-wide rollup
+        # (its local /slowops is an empty log), on a master the same local
+        # data; plain daemons 404 it and fall back to /slowops
+        slow_err = None
+        for path in ("/api/slowops", "/slowops"):
+            try:
+                slowops = json.loads(scrape(args.addr, path)).get("slowops", [])
+                slow_err = None
+                break
+            except Exception as e:
+                slow_err = f"{args.addr}{path}: {e}"
+        if slow_err is not None:  # neither shape answered — not a quiet
+            print(f"warning: slowops unavailable: {slow_err}",  # cluster
+                  file=sys.stderr)
+
     rows = diff_metrics(before, after, elapsed)
     if args.filter:
         rows = [r for r in rows if args.filter in r["metric"]]
     if not args.all:
         rows = [r for r in rows if r["delta"] != 0]
     if args.json:
-        print(json.dumps({"interval_s": round(elapsed, 3), "rows": rows},
-                         indent=2), file=out)
+        blob = {"interval_s": round(elapsed, 3), "rows": rows}
+        if args.slowops:
+            blob["slowops"] = slowops
+        print(json.dumps(blob, indent=2), file=out)
         return 0
     if not rows:
         print(f"(no metric moved in {elapsed:.1f}s; --all shows statics)",
               file=out)
-        return 0
-    w = max(len(r["metric"]) for r in rows)
-    print(f"{'METRIC'.ljust(w)}  {'VALUE':>14}  {'DELTA':>12}  {'RATE/S':>12}",
-          file=out)
-    for r in rows:
-        print(f"{r['metric'].ljust(w)}  {r['value']:>14g}  "
-              f"{r['delta']:>12g}  {r['rate']:>12g}", file=out)
+    else:
+        w = max(len(r["metric"]) for r in rows)
+        print(f"{'METRIC'.ljust(w)}  {'VALUE':>14}  {'DELTA':>12}  {'RATE/S':>12}",
+              file=out)
+        for r in rows:
+            print(f"{r['metric'].ljust(w)}  {r['value']:>14g}  "
+                  f"{r['delta']:>12g}  {r['rate']:>12g}", file=out)
+    if args.slowops:
+        shown = slowops[-20:]
+        note = (f"showing last {len(shown)} of {len(slowops)}"
+                if len(slowops) > len(shown) else f"{len(slowops)} recent")
+        print(f"\nSLOW OPS ({note})", file=out)
+        for rec in shown:
+            print(f"  {rec.get('ts', '-')}  {rec.get('module', '?')}."
+                  f"{rec.get('op', '?')}  {rec.get('latency_ms', 0):.1f}ms"
+                  f"  trace={rec.get('trace_id', '-')}"
+                  + (f"  err={rec['err']}" if rec.get("err") else ""),
+                  file=out)
+            if rec.get("track"):
+                print(f"    track: {rec['track']}", file=out)
     return 0
 
 

@@ -1,4 +1,4 @@
-"""obslint — static lint for the observability plane's two invariants.
+"""obslint — static lint for the observability plane's invariants.
 
 1. **No high-cardinality metric labels.** A label whose KEY names a per-object
    id (inode, blob id, volume id, extent id, request/trace id, path, upload
@@ -17,6 +17,16 @@
    Every HTTP connection rides the keep-alive pool (or its NullPool opt-out)
    so reuse/evict counters stay truthful and the connect-per-request data
    path can never be silently reintroduced.
+
+4. **No latency/deadline arithmetic on `time.time()`.** The wall clock jumps
+   (NTP slew/step, manual set); a retry deadline or an idle-TTL delta built
+   from it can expire instantly or never. Any `+`/`-` arithmetic whose
+   operand is a direct `time.time()` call is flagged — elapsed times and
+   deadlines use `time.monotonic()` (or `perf_counter`). Wall stamps that
+   only get STORED or COMPARED as timestamps (proposal `now=`, mtimes,
+   heartbeat records) don't involve such arithmetic and pass; files whose
+   wall-clock arithmetic is cross-process protocol (authnode ticket
+   freshness windows) are allowlisted.
 
 Wired into tier-1 (tests/test_obslint.py) so a regression fails fast.
 """
@@ -47,6 +57,21 @@ ALLOWED_STATS_DICTS = {
 # the ONE module allowed to construct HTTPConnection: the keep-alive pool
 CONN_POOL_PATH = "rpc/pool.py"
 
+# files whose wall-clock arithmetic is PROTOCOL, not latency: authnode
+# verifies request-timestamp freshness across processes, where monotonic
+# clocks don't compare and wall time is the contract
+ALLOWED_WALLCLOCK_FILES = ("authnode/server.py",)
+
+
+def _is_walltime_call(node: ast.expr) -> bool:
+    """A direct time.time() call (any `* as <alias>` import of the module:
+    `time.time()`, `_time.time()`)."""
+    return (isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "time"
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id.lstrip("_") == "time")
+
 
 def _labels_arg(call: ast.Call) -> ast.expr | None:
     """The labels argument of a metric call: 2nd positional or labels=."""
@@ -64,6 +89,7 @@ def lint_source(src: str, relpath: str) -> list[str]:
         tree = ast.parse(src)
     except SyntaxError as e:
         return [f"{relpath}: syntax error: {e}"]
+    src_lines = src.splitlines()
     findings: list[str] = []
     for node in ast.walk(tree):
         # -- rule 1: banned/high-cardinality metric label keys --------------
@@ -95,6 +121,21 @@ def lint_source(src: str, relpath: str) -> list[str]:
                     "every HTTP conn rides rpc/pool.py (ConnectionPool or "
                     "NullPool), so keep-alive reuse and evict counters stay "
                     "truthful; the unpooled path must not sneak back")
+        # -- rule 4: latency/deadline arithmetic on the wall clock ----------
+        if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Add, ast.Sub)) \
+                and (_is_walltime_call(node.left) or _is_walltime_call(node.right)) \
+                and not any(relpath.endswith(sfx)
+                            for sfx in ALLOWED_WALLCLOCK_FILES) \
+                and "wallclock:" not in (
+                    src_lines[node.lineno - 1]
+                    if 0 < node.lineno <= len(src_lines) else ""):
+            # a `# wallclock: <why>` pragma documents the exception — wall
+            # arithmetic that IS the protocol (e.g. a tx deadline riding a
+            # raft proposal, compared by every replica)
+            findings.append(
+                f"{relpath}:{node.lineno}: latency/deadline arithmetic on "
+                "time.time() — the wall clock jumps (NTP, manual set); "
+                "deltas and deadlines use time.monotonic()")
         # -- rule 2: ad-hoc self.*stats* = {...} dict counters --------------
         if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
             for tgt in node.targets:

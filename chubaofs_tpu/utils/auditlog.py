@@ -64,13 +64,18 @@ class RotatingFile:
                 self._rotate_locked()
 
     def read_lines(self) -> list[str]:
-        """Every retained line, oldest first, across rotations."""
+        """Every retained line, oldest first, across rotations. Reads race
+        the writer's rotation (the trace/slowop HTTP side-doors read a LIVE
+        rotor): a file that vanishes between listing and open — os.replace'd
+        up the ring — is skipped, never a request-killing error."""
         out: list[str] = []
         for n in range(self.max_files, -1, -1):
             p = self.path(n)
-            if os.path.exists(p):
+            try:
                 with open(p, encoding="utf-8") as f:
                     out.extend(line.rstrip("\n") for line in f if line.strip())
+            except OSError:
+                continue
         return out
 
     def close(self):
@@ -198,6 +203,16 @@ def configure_slowop(logdir: str | None = None,
         return _slowop
 
 
+def recent_slowops(n: int = 100) -> list[dict]:
+    """The newest n slow-op records — the one accessor behind every HTTP
+    face of the audit (RPCServer /slowops, the master's /api/slowops
+    alias), so the windows can't drift apart. n<=0 is an empty window,
+    never the [-0:] whole-log slice."""
+    if n <= 0:
+        return []
+    return slowop_log().records()[-n:]
+
+
 def record_slow_op(module: str, op: str, latency_s: float, span=None,
                    err: str = "") -> bool:
     """Entry-point hook: cheap when disabled (one cached float compare, no
@@ -218,6 +233,12 @@ def record_slow_op(module: str, op: str, latency_s: float, span=None,
 
         registry("slowop").counter("slow_ops_total",
                                    {"module": module, "op": op}).add()
+        if span is not None:
+            # slow ops are always-on for the trace sink: the span behind
+            # every slowop line persists whatever CFS_TRACE_SAMPLE says
+            from chubaofs_tpu.utils import tracesink
+
+            tracesink.force(span)
         return True
     except Exception:
         return False

@@ -30,7 +30,7 @@ class ConnPool:
             bucket = self._idle.get(addr, [])
             while bucket:
                 sock, ts = bucket.pop()
-                if time.time() - ts <= self.idle_timeout:
+                if time.monotonic() - ts <= self.idle_timeout:
                     return sock
                 sock.close()
         host, port = self._split(addr)
@@ -44,7 +44,7 @@ class ConnPool:
             sock.close()
             return
         with self._lock:
-            self._idle.setdefault(addr, []).append((sock, time.time()))
+            self._idle.setdefault(addr, []).append((sock, time.monotonic()))
 
     def close(self) -> None:
         with self._lock:

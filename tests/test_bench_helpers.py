@@ -94,3 +94,51 @@ def test_stage_grouped_layout_contract(rng):
     _, g = rs.group_stack(kernel.parity_bits, 8)
     assert data.shape == (8 // g, g * 6, 256)
     assert mat_s.shape == (g * 24, g * 48)
+
+
+def test_probe_failure_emits_staged_diagnostics(monkeypatch, capsys):
+    """A dead TPU probe must die diagnosable: the single JSON line names the
+    probe phase that failed, the exact command, timing, rc and stderr tail —
+    a bare rc=2 with one opaque string cost two undiagnosable bench rounds."""
+    import json as _json
+    import subprocess
+
+    def fake_run(cmd, capture_output=True, timeout=None, check=True):
+        err = subprocess.CalledProcessError(1, cmd)
+        # the child survived the import but died listing devices
+        err.stdout = b"stage:python_up\nstage:jax_imported\n"
+        err.stderr = b"RuntimeError: unable to initialize backend 'tpu'\n"
+        raise err
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    with pytest.raises(SystemExit) as exc:
+        bench._resolve_device(timeout_s=5.0)
+    assert exc.value.code == 2
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    blob = _json.loads(line)
+    assert blob["error"].startswith(
+        "TPU backend probe failed in backend_init_list_devices")
+    probe = blob["probe"]
+    assert probe["failed_in"] == "backend_init_list_devices"
+    assert probe["stages_reached"] == ["stage:python_up", "stage:jax_imported"]
+    assert probe["rc"] == 1 and probe["timed_out"] is False
+    assert "unable to initialize backend" in probe["stderr_tail"]
+    assert probe["cmd"][0] and "-c" in probe["cmd"]
+    assert probe["elapsed_s"] >= 0
+
+
+def test_probe_timeout_names_hung_phase(monkeypatch, capsys):
+    import json as _json
+    import subprocess
+
+    def fake_run(cmd, capture_output=True, timeout=None, check=True):
+        raise subprocess.TimeoutExpired(cmd, timeout,
+                                        output=b"stage:python_up\n")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    with pytest.raises(SystemExit):
+        bench._resolve_device(timeout_s=1.0)
+    blob = _json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert blob["probe"]["failed_in"] == "import_jax"  # hung importing jax
+    assert blob["probe"]["timed_out"] is True
+    assert "tunnel down?" in blob["error"]

@@ -362,3 +362,82 @@ def test_slowop_disabled_by_default():
 
     assert os.environ.get("CFS_SLOWOP_MS") in (None, "", "0")
     assert record_slow_op("m", "op", 99.0) in (False,)
+
+
+# -- ISSUE 5 satellites: exporter edge cases + span-id carrier ------------------
+
+
+def test_summary_quantile_edge_cases():
+    s = exporter.Summary()
+    assert s.quantile(0.5) == 0.0  # empty: no samples, no quantile
+    s.observe(0.003)
+    # single sample: every in-range q reports its bucket's upper bound
+    assert s.quantile(0.0) == 0.001  # rank 0 satisfied by the first bucket
+    assert s.quantile(0.5) == 0.005
+    assert s.quantile(1.0) == 0.005
+    # out-of-range q (>1): rank exceeds count, degrades to the observed max
+    assert s.quantile(2.0) == 0.003
+    # single-bucket layout: in-bucket -> the bucket bound; beyond -> max
+    s2 = exporter.Summary(buckets=(1.0,))
+    s2.observe(0.5)
+    s2.observe(2.0)
+    assert s2.quantile(0.5) == 1.0
+    assert s2.quantile(0.99) == 2.0
+
+
+def test_render_label_escaping_exact_roundtrip():
+    reg = exporter.Registry(cluster="", module="esc2")
+    reg.counter("c", {"vol": 'a"b\\c\nd'}).add(2)
+    text = reg.render()
+    # the hostile value renders on ONE line with quote/backslash/newline
+    # escaped per the exposition format, and parses back exactly
+    vals = parse_metrics(text)
+    assert vals['cfs_esc2_c{vol="a\\"b\\\\c\\nd"}'] == 2.0
+    # neighbors in the same registry stay scrapeable
+    reg.gauge("ok").set(1)
+    assert parse_metrics(reg.render())["cfs_esc2_ok"] == 1.0
+
+
+def test_span_id_carrier_roundtrip_lowercased():
+    span = trace.Span("carrier")
+    carrier = {}
+    span.inject(carrier)
+    lowered = {k.lower(): v for k, v in carrier.items()}
+    cont = trace.start_span("next", carrier=lowered)
+    # the continued span knows its cross-process parent even through
+    # header-lowercasing transports (rpc Request lower-cases keys)
+    assert cont.remote_parent == span.span_id
+    assert cont.trace_id == span.trace_id
+    assert trace.extract_span_id(lowered) == span.span_id
+    assert trace.extract_span_id({}) is None
+
+
+def test_fs_chain_spans_reach_sink(fs_cluster, tmp_path):
+    """FUSE/Mount -> meta submit -> metanode -> raft: the whole metadata
+    chain lands in the trace sink as one linked span tree with the raft
+    commit wait attributed as a named stage."""
+    from chubaofs_tpu.client.mount import Mount, O_CREAT, O_RDWR
+    from chubaofs_tpu.tools import cfstrace
+    from chubaofs_tpu.utils import tracesink
+
+    snk = tracesink.configure(str(tmp_path / "sink"), sample=1.0)
+    try:
+        m = Mount(fs_cluster.client("obs"), volume="obs")
+        with trace.Span("fs.probe") as span:
+            fd = m.open("/sinkchain.txt", O_CREAT | O_RDWR)
+            m.write(fd, b"payload")
+            m.close(fd)
+        m.umount()
+        recs = snk.records(span.trace_id)
+        ops = {r["op"] for r in recs}
+        assert any(op.startswith("mount.") for op in ops), ops
+        assert any(op.startswith("meta.") for op in ops), ops
+        stage_names = {s[0] for r in recs for s in r.get("stages", [])}
+        assert "raft" in stage_names, stage_names
+        # the tree assembles: every meta span hangs off a mount span
+        roots, children = cfstrace.build_tree(recs)
+        assert any(children.get(r["span_id"]) for r in recs)
+        rep = cfstrace.critical_path(recs)
+        assert rep["root_op"] == "fs.probe" and rep["coverage"] > 0.2
+    finally:
+        tracesink.configure(sample=0.0)

@@ -61,3 +61,54 @@ def test_allows_legacy_views_and_bounded_labels():
     assert obslint.lint_source(legacy, "codec/service.py") == []
     bounded = 'def f(reg, op):\n    reg.counter("ops", {"op": op}).add()\n'
     assert obslint.lint_source(bounded, "x.py") == []
+
+
+# -- rule 4: latency deltas must ride the monotonic clock ----------------------
+
+
+def test_flags_walltime_deadline_arithmetic():
+    src = textwrap.dedent("""
+        import time
+        def f(timeout):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                pass
+    """)
+    findings = obslint.lint_source(src, "somewhere/x.py")
+    assert len(findings) == 1 and "time.monotonic()" in findings[0]
+
+
+def test_flags_walltime_elapsed_subtraction_and_alias():
+    src = textwrap.dedent("""
+        import time as _time
+        def f(t0, ttl):
+            return _time.time() - t0 <= ttl
+    """)
+    findings = obslint.lint_source(src, "x.py")
+    assert len(findings) == 1 and "wall clock" in findings[0]
+
+
+def test_walltime_stamps_and_monotonic_pass():
+    src = textwrap.dedent("""
+        import time
+        def f(sm):
+            sm.apply(now=time.time())          # proposal stamp: wall by design
+            deadline = time.monotonic() + 5    # delta: monotonic is correct
+            return time.time() < deadline
+    """)
+    assert obslint.lint_source(src, "x.py") == []
+
+
+def test_walltime_allowlist_and_pragma():
+    src = textwrap.dedent("""
+        import time
+        def fresh(ts):
+            return abs(time.time() - ts) > 300
+    """)
+    # authnode's request-freshness window is cross-process wall time
+    assert obslint.lint_source(src, "authnode/server.py") == []
+    assert len(obslint.lint_source(src, "elsewhere.py")) == 1
+    pragma = ("import time\n"
+              "def f(ttl):\n"
+              "    return time.time() + ttl  # wallclock: protocol stamp\n")
+    assert obslint.lint_source(pragma, "elsewhere.py") == []
