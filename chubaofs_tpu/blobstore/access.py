@@ -41,6 +41,7 @@ from chubaofs_tpu.codec.codemode import CodeMode, get_tactic
 from chubaofs_tpu.codec.service import CodecService, default_service
 from chubaofs_tpu.utils.auditlog import record_slow_op
 from chubaofs_tpu.utils.breaker import CircuitBreaker
+from chubaofs_tpu.utils.locks import SanitizedLock
 from chubaofs_tpu.utils.exporter import BATCH_BUCKETS, registry
 
 MAX_BLOB_SIZE = 4 * 1024 * 1024
@@ -191,7 +192,7 @@ class Access:
         self.qos_timeout = 30.0  # max throttle wait before failing the request
         self._disk_sems: dict[int, threading.Semaphore] = {}
         self._punished: dict[int, float] = {}
-        self._punish_lock = threading.Lock()
+        self._punish_lock = SanitizedLock(name="access.punish")
         # client-side breaker around control-plane (allocator/proxy) calls:
         # a dead allocator fails PUTs fast instead of stacking every request
         # behind its timeouts (stream_put.go:68 hystrix analog)
@@ -211,7 +212,7 @@ class Access:
         self._probe_io = ThreadPoolExecutor(max_workers=4,
                                             thread_name_prefix="access-probe-io")
         self._probing: set[tuple[int, int]] = set()  # (vid, bid) dedupe
-        self._probe_lock = threading.Lock()
+        self._probe_lock = SanitizedLock(name="access.probe")
         # data-path pipeline: bounded encode->write overlap window for
         # multi-blob PUTs, and blob-level GET readahead depth. 0 = serial.
         self.pipeline_window = int(os.environ.get("CFS_PIPELINE_WINDOW", "3"))
@@ -1010,3 +1011,15 @@ class Access:
         self._check_sig(loc)
         for blob in loc.blobs:
             self.proxy.send_blob_delete(blob.vid, blob.bid)
+
+    def close(self) -> None:
+        """Shut down the gateway's worker pools (racelint: unjoined-thread).
+        wait=False: a wedged blobnode may pin a write worker up to
+        write_deadline, and close() runs on teardown paths (MiniCluster,
+        daemon reload) that must not inherit that stall; in-flight futures
+        fail on their own deadlines."""
+        self._pipe_pool.shutdown(wait=False)
+        self._pool.shutdown(wait=False)
+        self._read_pool.shutdown(wait=False)
+        self._probe_pool.shutdown(wait=False)
+        self._probe_io.shutdown(wait=False)

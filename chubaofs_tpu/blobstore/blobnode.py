@@ -30,12 +30,12 @@ import ctypes
 import json
 import os
 import struct
-import threading
 import zlib
 from dataclasses import dataclass
 
 from chubaofs_tpu import chaos
 from chubaofs_tpu.utils import crc32block
+from chubaofs_tpu.utils.locks import SanitizedLock
 from chubaofs_tpu.utils.kvstore import open_kv
 
 MAGIC = 0x73686472  # "shdr"
@@ -98,7 +98,7 @@ class Chunk:
         self._base_path = path
         self._idx_path = path + ".idx"  # legacy json-line WAL (migrated)
         self._db = metadb
-        self._lock = threading.Lock()
+        self._lock = SanitizedLock(name="blobnode.chunk")
         self.shards: dict[int, ShardMeta] = {}
         self.gen = int(self._db.get(self._gen_key()) or 0)
         self._data_path = self._gen_path(self.gen)
@@ -363,7 +363,7 @@ class Disk:
         os.makedirs(os.path.join(root, "chunks"), exist_ok=True)
         self._sb_path = os.path.join(root, "superblock.json")
         self.metadb = open_kv(os.path.join(root, "metadb"))
-        self._lock = threading.Lock()
+        self._lock = SanitizedLock(name="blobnode.disk")
         self.chunks: dict[str, Chunk] = {}
         self._load()
 
@@ -432,7 +432,7 @@ class BlobNode:
             d = Disk(root, disk_id=node_id * 1000 + i)
             self.disks[d.disk_id] = d
         self._chunk_of_vuid: dict[int, tuple[int, str]] = {}
-        self._lock = threading.Lock()
+        self._lock = SanitizedLock(name="blobnode.node")
         # shard-IO observability: per-node TP metrics in the blobnode role
         # registry; optionally the mmap'd iostat block node-side viewers read
         # (common/iostat) — off by default so test fleets don't litter shm

@@ -81,6 +81,7 @@ class MiniCluster:
     def close(self):
         if self._owns_codec:  # never kill a shared/injected service
             self.codec.close()
+        self.access.close()
         for node in self.nodes.values():
             node.close()
         self.cm.close()

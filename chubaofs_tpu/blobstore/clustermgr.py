@@ -18,11 +18,11 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from dataclasses import dataclass, field
 
 from chubaofs_tpu.codec.codemode import CodeMode, get_tactic
+from chubaofs_tpu.utils.locks import SanitizedRLock
 
 DISK_NORMAL = "normal"
 DISK_BROKEN = "broken"
@@ -83,7 +83,7 @@ class ClusterMgr:
     """Single-group state machine; every mutation is an (op, args) apply."""
 
     def __init__(self, data_dir: str | None = None):
-        self._lock = threading.RLock()
+        self._lock = SanitizedRLock(name="clustermgr")
         self.disks: dict[int, DiskInfo] = {}
         self.volumes: dict[int, VolumeInfo] = {}
         self.scopes: dict[str, int] = {}
@@ -243,7 +243,7 @@ class ClusterMgr:
                 ("register_disk", {"az": 0, "rack": "", **s}) for s in specs])
 
     def _op_register_disk(self, disk_id: int, node_id: int, az: int, rack: str):
-        if disk_id not in self.disks:
+        if disk_id not in self.disks:  # racelint: _op_* appliers only run under self._lock (apply/_apply_batch take it)
             self.disks[disk_id] = DiskInfo(disk_id, node_id, az, rack)
         self.disks[disk_id].last_heartbeat = time.time()
 

@@ -10,10 +10,10 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 
 from chubaofs_tpu.blobstore.clustermgr import ClusterMgr, VolumeInfo
+from chubaofs_tpu.utils.locks import SanitizedLock
 
 TOPIC_SHARD_REPAIR = "shard_repair"
 TOPIC_BLOB_DELETE = "blob_delete"
@@ -23,7 +23,7 @@ class TopicQueue:
     """Durable append-only topic with consumer offsets (the Kafka stand-in)."""
 
     def __init__(self, path: str | None = None):
-        self._lock = threading.Lock()
+        self._lock = SanitizedLock(name="proxy.topic")
         self._msgs: list[dict] = []
         self._offsets: dict[str, int] = {}
         self._path = path
@@ -79,7 +79,7 @@ class Proxy:
         if active_vols is None:
             active_vols = int(os.environ.get("CFS_PROXY_ACTIVE_VOLS", "2"))
         self.active_vols = max(1, active_vols)
-        self._lock = threading.Lock()
+        self._lock = SanitizedLock(name="proxy.alloc")
         # code_mode -> (volume grants, monotonic expiry)
         self._cached: dict[int, tuple[list[VolumeInfo], float]] = {}
         self._rr: dict[int, int] = {}

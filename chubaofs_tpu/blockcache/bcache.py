@@ -219,7 +219,7 @@ class BcacheClient:
     def cache_key(volume: str, ino: int, offset: int) -> str:
         return f"{volume}_{ino}_{offset}"
 
-    def _conn(self) -> socket.socket:
+    def _conn_locked(self) -> socket.socket:
         if self._sock is None:
             self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             self._sock.settimeout(5.0)
@@ -229,7 +229,7 @@ class BcacheClient:
     def _call(self, header: dict, data: bytes = b"") -> tuple[dict, bytes]:
         with self._lock:
             try:
-                sock = self._conn()
+                sock = self._conn_locked()
                 _send_msg(sock, header, data)
                 return _recv_msg(sock)
             except (ConnectionError, OSError):

@@ -51,7 +51,9 @@ def run_soak(root: str, plan: FaultPlan | str, seed: int, rounds: int = 6,
     c = MiniCluster(root, n_nodes=n_nodes, disks_per_node=disks_per_node)
     # soak-tuned gateway: a wedged node must cost fractions of a second, not
     # the production 3s/10s windows, and hung reads pin pool workers until
-    # the fault lifts — size the pools for that
+    # the fault lifts — size the pools for that (the displaced stock gateway
+    # gives up its executors first: MiniCluster.close only sees the new one)
+    c.access.close()
     c.access = Access(c.cm, c.proxy, c.nodes, codec=c.codec, max_workers=64,
                       read_deadline=read_deadline,
                       write_deadline=write_deadline)

@@ -30,6 +30,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from chubaofs_tpu.ops import rs
+from chubaofs_tpu.utils.locks import SanitizedLock
 
 MIN_BUCKET = 16 * 1024
 
@@ -107,14 +108,14 @@ class CodecService:
         self._thread = threading.Thread(target=self._run, daemon=True, name="codec-svc")
         self._started = False
         self._closed = False
-        self._lock = threading.Lock()
+        self._lock = SanitizedLock(name="codec.lifecycle")
         # dispatcher observability: how well jobs coalesce into device batches
         # (same counter shape as MultiRaft.drain_stats for the raft drain).
         # The codec role registry (cfs_codec_*) is the primary surface; this
         # dict is the legacy view, mutated only under _stats_lock so readers
         # get consistent snapshots (stats_snapshot).
         self.stats = {"batches": 0, "jobs": 0, "max_batch": 0}
-        self._stats_lock = threading.Lock()
+        self._stats_lock = SanitizedLock(name="codec.stats")
 
     def _ensure_started(self):
         with self._lock:
@@ -349,7 +350,7 @@ class CodecService:
 
 
 _default: CodecService | None = None
-_default_lock = threading.Lock()
+_default_lock = SanitizedLock(name="codec.default")
 
 
 def default_service() -> CodecService:

@@ -42,6 +42,7 @@ from chubaofs_tpu.proto.packet import (
 )
 from chubaofs_tpu.utils.auditlog import record_slow_op
 from chubaofs_tpu.utils.exporter import registry
+from chubaofs_tpu.utils.locks import SanitizedLock
 from chubaofs_tpu.raft.server import MultiRaft, NotLeaderError, StateMachine
 from chubaofs_tpu.storage.extent_store import (
     ExtentNotFound, ExtentStore, MIN_NORMAL_EXTENT_ID, StorageError,
@@ -147,6 +148,10 @@ class SpaceManager:
         for d in disks:
             os.makedirs(d, exist_ok=True)
         self.partitions: dict[int, DataPartition] = {}
+        # guards partition create/load: concurrent OP_CREATE_PARTITION
+        # packets for one pid must not double-create the DataPartition (and
+        # its raft group) — racelint check-then-act
+        self._lock = SanitizedLock(name="datanode.space")
 
     def _pick_disk(self) -> str:
         # most free space, fewest hosted partitions as the tiebreak
@@ -158,23 +163,25 @@ class SpaceManager:
 
     def create_partition(self, pid: int, peers: list[int], hosts: list[str],
                          raft: MultiRaft | None) -> DataPartition:
-        if pid in self.partitions:
-            self.partitions[pid].update_membership(peers, hosts)
-            return self.partitions[pid]
-        root = os.path.join(self._pick_disk(), f"dp_{pid}")
-        os.makedirs(root, exist_ok=True)
-        dp = DataPartition(pid, root, peers, hosts, raft)
-        self.partitions[pid] = dp
-        return dp
+        with self._lock:
+            if pid in self.partitions:
+                self.partitions[pid].update_membership(peers, hosts)
+                return self.partitions[pid]
+            root = os.path.join(self._pick_disk(), f"dp_{pid}")
+            os.makedirs(root, exist_ok=True)
+            dp = DataPartition(pid, root, peers, hosts, raft)
+            self.partitions[pid] = dp
+            return dp
 
     def load_all(self, raft: MultiRaft | None) -> None:
-        for disk in self.disks:
-            for name in os.listdir(disk):
-                if name.startswith("dp_"):
-                    pid = int(name[3:])
-                    if pid not in self.partitions:
-                        self.partitions[pid] = DataPartition.load(
-                            os.path.join(disk, name), raft)
+        with self._lock:
+            for disk in self.disks:
+                for name in os.listdir(disk):
+                    if name.startswith("dp_"):
+                        pid = int(name[3:])
+                        if pid not in self.partitions:
+                            self.partitions[pid] = DataPartition.load(
+                                os.path.join(disk, name), raft)
 
 
 class DataNode:

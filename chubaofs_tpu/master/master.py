@@ -19,6 +19,7 @@ import time
 from dataclasses import dataclass, field
 
 from chubaofs_tpu.raft.server import MultiRaft, StateMachine
+from chubaofs_tpu.utils.locks import SanitizedLock
 
 MASTER_GROUP = 1
 META_RANGE_STEP = 1 << 24  # inos per partition before splitting
@@ -203,7 +204,7 @@ class MasterSM(StateMachine):
         # `now` is stamped by the PROPOSER: calling time.time() inside apply
         # would make replicas and WAL replay record different values, so a
         # restarted master could trust dead nodes as freshly heartbeaten
-        if node_id not in self.nodes:
+        if node_id not in self.nodes:  # racelint: _op_* appliers are serialized by the raft drain pump
             self.nodes[node_id] = NodeInfo(
                 node_id, kind, addr, zone=zone,
                 nodeset=self._assign_nodeset(kind, zone),
@@ -495,7 +496,10 @@ class Master:
         self.raft_config_hook = None
         self.remove_partition_hook = None
         # nodes already fully drained by the dead-node sweep; in-memory only
-        # (rebuilt by one sweep after a restart), cleared on returning heartbeat
+        # (rebuilt by one sweep after a restart), cleared on returning heartbeat.
+        # Own micro-lock: heartbeat clears this set on its hot path and must
+        # never wait out a migration-length _decomm_lock hold
+        self._drained_lock = SanitizedLock(name="master.drained")
         self._dead_drained: set[int] = set()
 
     def _apply(self, op: str, **args):
@@ -549,7 +553,8 @@ class Master:
                   used_space: int | None = None):
         # a returning node may receive new placements again, so the dead-node
         # sweep must re-examine it if it dies a second time
-        self._dead_drained.discard(node_id)
+        with self._drained_lock:
+            self._dead_drained.discard(node_id)
         self._apply("heartbeat", node_id=node_id, partition_count=partition_count,
                     cursors=cursors, now=time.time(),
                     total_space=total_space, used_space=used_space)
@@ -1038,14 +1043,17 @@ class Master:
         now = time.time() if now is None else now
         moved = 0
         for n in list(self.sm.nodes.values()):
-            if n.status != "inactive" or n.node_id in self._dead_drained:
+            with self._drained_lock:
+                drained = n.node_id in self._dead_drained
+            if n.status != "inactive" or drained:
                 continue
             if not n.last_heartbeat or now - n.last_heartbeat < dead_after:
                 continue
             with self._decomm_lock:
                 before = self._replica_count(n.node_id)
                 if before == 0:
-                    self._dead_drained.add(n.node_id)
+                    with self._drained_lock:
+                        self._dead_drained.add(n.node_id)
                     continue
                 try:
                     if n.kind == "meta":
@@ -1057,7 +1065,8 @@ class Master:
                 remaining = self._replica_count(n.node_id)
                 moved += before - remaining
                 if remaining == 0:
-                    self._dead_drained.add(n.node_id)
+                    with self._drained_lock:
+                        self._dead_drained.add(n.node_id)
         return moved
 
     def update_volume(self, name: str, capacity: int | None = None,
@@ -1140,7 +1149,8 @@ class Master:
                 continue
             try:
                 self._apply("remove_node", node_id=n.node_id)
-                self._dead_drained.discard(n.node_id)
+                with self._drained_lock:
+                    self._dead_drained.discard(n.node_id)
                 pruned.append(n.node_id)
             except MasterError:
                 pass

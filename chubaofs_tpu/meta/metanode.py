@@ -8,11 +8,11 @@ are proposed to the partition's leader, reads served from leader state.
 
 from __future__ import annotations
 
-import threading
 from concurrent.futures import Future
 
 from chubaofs_tpu.meta.partition import MetaError, MetaPartitionSM
 from chubaofs_tpu.raft.server import MultiRaft, NotLeaderError
+from chubaofs_tpu.utils.locks import SanitizedLock
 
 
 class OpError(Exception):
@@ -26,7 +26,7 @@ class MetaNode:
         self.node_id = node_id
         self.raft = raft
         self.partitions: dict[int, MetaPartitionSM] = {}
-        self._lock = threading.Lock()
+        self._lock = SanitizedLock(name="metanode.partitions")
         # injected by the deployment: called with (inode) to purge file data;
         # must RAISE on failure so the orphan stays queued and is retried
         self.data_purge_hook = None

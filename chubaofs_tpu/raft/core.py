@@ -248,7 +248,7 @@ class RaftCore:
                 self.role = ROLE_FOLLOWER
                 self.leader = None
                 return
-            if node_id in self.peers:
+            if node_id in self.peers:  # racelint: RaftCore state is only touched under the owning MultiRaft node lock
                 self.peers.remove(node_id)
                 self.next_index.pop(node_id, None)
                 self.match_index.pop(node_id, None)

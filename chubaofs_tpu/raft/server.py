@@ -36,6 +36,7 @@ from concurrent.futures import Future
 
 from chubaofs_tpu import chaos
 from chubaofs_tpu.blobstore import trace
+from chubaofs_tpu.utils.locks import SanitizedLock, SanitizedRLock
 from chubaofs_tpu.raft import codec
 from chubaofs_tpu.raft.core import Entry, Msg, NotLeaderError, RaftCore, ROLE_LEADER
 
@@ -62,7 +63,7 @@ class InProcNet:
     def __init__(self):
         self.nodes: dict[int, "MultiRaft"] = {}
         self.partitions: set[frozenset] = set()  # simulated network partitions
-        self._lock = threading.Lock()
+        self._lock = SanitizedLock(name="raft.net")
 
     def register(self, node: "MultiRaft"):
         with self._lock:
@@ -126,7 +127,7 @@ class _Group:
         self.waiters: dict[int, tuple[int, Future]] = {}  # index -> (term, future)
         # group commit: futures FIFO-parallel to core.pending — both only
         # mutated under pending_lock, so queue order IS future order
-        self.pending_lock = threading.Lock()
+        self.pending_lock = SanitizedLock(name="raft.pending")
         self.pending_futs: deque[Future] = deque()
         self.last_leader: int | None = None
         if wal_path:
@@ -249,14 +250,18 @@ class MultiRaft:
         self.wal_dir = wal_dir
         self.snapshot_every = snapshot_every
         self.groups: dict[int, _Group] = {}
-        self._lock = threading.RLock()
+        # per-node name: tick/deliver must NEVER hold two node locks at once
+        # (delivery acquires the destination's), and distinct names let the
+        # sanitizer prove it — a nodeA->nodeB + nodeB->nodeA edge pair is the
+        # deadlock this file's send-outside-the-lock discipline prevents
+        self._lock = SanitizedRLock(name=f"raft.node{node_id}")
         # proposal pump: proposers enqueue + wake; the pump drains (the
         # reference's propose-channel/run-goroutine split). Lazy: nodes that
         # never see a proposal never spawn the thread.
         self._prop_wake = threading.Event()
         self._dirty: deque[_Group] = deque()
         self._pump_started = False
-        self._pump_lock = threading.Lock()
+        self._pump_lock = SanitizedLock(name="raft.pumpstart")
         self.pump_dead = False  # a drain crash poisons the node: fail fast
         # group-commit observability. The role registry (cfs_raft_*) is the
         # primary surface — counters + a batch-occupancy histogram rendered
@@ -264,7 +269,7 @@ class MultiRaft:
         # view (perfbench resets/reads it), updated only under _stats_lock
         # so readers can take a consistent snapshot.
         self.drain_stats = {"rounds": 0, "entries": 0, "max_batch": 0}
-        self._stats_lock = threading.Lock()
+        self._stats_lock = SanitizedLock(name="raft.stats")
         net.register(self)
 
     # -- group lifecycle -----------------------------------------------------

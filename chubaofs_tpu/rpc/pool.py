@@ -28,11 +28,11 @@ from __future__ import annotations
 
 import http.client
 import os
-import threading
 import time
 
 from chubaofs_tpu import chaos
 from chubaofs_tpu.utils.exporter import registry
+from chubaofs_tpu.utils.locks import SanitizedLock
 
 
 def _counter(name: str, labels: dict | None = None):
@@ -57,7 +57,7 @@ class ConnectionPool:
         self.idle_ttl = idle_ttl
         self.timeout = timeout
         self._idle: dict[str, list[tuple[http.client.HTTPConnection, float]]] = {}
-        self._lock = threading.Lock()
+        self._lock = SanitizedLock(name="rpc.pool")
 
     def checkout(self, host: str,
                  timeout: float | None = None) -> tuple[http.client.HTTPConnection, bool]:
@@ -66,21 +66,31 @@ class ConnectionPool:
         evicted on the way."""
         chaos.failpoint("rpc.pool.checkout")
         now = time.monotonic()
+        reuse = None
+        expired: list[http.client.HTTPConnection] = []
         with self._lock:
             bucket = self._idle.get(host)
             while bucket:
                 conn, parked = bucket.pop()  # newest-first: warmest socket
                 if now - parked <= self.idle_ttl:
-                    if timeout is not None:
-                        # the parked socket keeps its creator's timeout;
-                        # rebind to THIS caller's budget
-                        conn.timeout = timeout
-                        if conn.sock is not None:
-                            conn.sock.settimeout(timeout)
-                    _counter("pool_reuse").add()
-                    return conn, True
-                conn.close()
-                _counter("pool_evict", {"reason": "idle_ttl"}).add()
+                    reuse = conn
+                    break
+                # close OUTSIDE the lock: tearing down a dead socket can
+                # block for ~100ms+, and every other checkout would queue
+                # behind it (found by the cfs_lock_hold_ms audit)
+                expired.append(conn)
+        for conn in expired:
+            conn.close()
+            _counter("pool_evict", {"reason": "idle_ttl"}).add()
+        if reuse is not None:
+            if timeout is not None:
+                # the parked socket keeps its creator's timeout;
+                # rebind to THIS caller's budget
+                reuse.timeout = timeout
+                if reuse.sock is not None:
+                    reuse.sock.settimeout(timeout)
+            _counter("pool_reuse").add()
+            return reuse, True
         _counter("pool_miss").add()
         conn = http.client.HTTPConnection(
             host, timeout=self.timeout if timeout is None else timeout)
@@ -167,7 +177,7 @@ class NullPool:
 
 
 _default: ConnectionPool | NullPool | None = None
-_default_lock = threading.Lock()
+_default_lock = SanitizedLock(name="rpc.pool.default")
 
 
 def default_pool() -> ConnectionPool | NullPool:

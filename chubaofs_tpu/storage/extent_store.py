@@ -29,11 +29,11 @@ import ctypes.util
 import json
 import os
 import struct
-import threading
 import zlib
 
 from chubaofs_tpu import chaos
 from chubaofs_tpu.proto.packet import TINY_EXTENT_COUNT, is_tiny_extent
+from chubaofs_tpu.utils.locks import SanitizedRLock
 
 BLOCK_SIZE = 64 * 1024  # CRC granularity (storage/extent.go block crc)
 PAGE_SIZE = 4096  # tiny-extent append alignment
@@ -88,7 +88,7 @@ class ExtentStore:
         self.crc_dir = os.path.join(root, "crc")
         os.makedirs(self.ext_dir, exist_ok=True)
         os.makedirs(self.crc_dir, exist_ok=True)
-        self._lock = threading.RLock()
+        self._lock = SanitizedRLock(name="extent_store")
         self._deleted: set[int] = set()
         self._tiny_holes: dict[int, list[tuple[int, int]]] = {}
         self._delete_journal = os.path.join(root, "deleted.jsonl")

@@ -37,8 +37,17 @@ def main(argv=None) -> int:
                    help="state dir (default: a fresh temp dir per plan)")
     p.add_argument("--verify-repro", action="store_true",
                    help="run each plan twice; event logs must be identical")
+    p.add_argument("--sanitize", action="store_true",
+                   help="arm the lock-order sanitizer (CFS_LOCK_SANITIZER=1) "
+                        "for the whole soak; any lock inversion observed "
+                        "under fault load fails the run")
     p.add_argument("--json", action="store_true", help="machine-readable out")
     args = p.parse_args(argv)
+
+    if args.sanitize:
+        # before run_soak builds any cluster: locks check the env when
+        # CONSTRUCTED, so this must precede every component import-and-build
+        os.environ["CFS_LOCK_SANITIZER"] = "1"
 
     from chubaofs_tpu.chaos.soak import SoakFailure, run_soak
 
@@ -72,8 +81,18 @@ def main(argv=None) -> int:
             results.append({"plan": plan, "ok": False,
                             "error": "event logs diverged across identical "
                                      "seeded runs"})
+    sanitizer = None
+    if args.sanitize:
+        from chubaofs_tpu.utils import locks
+
+        sanitizer = locks.report()
+        if sanitizer["inversions"]:
+            ok = False
     if args.json:
-        print(json.dumps({"ok": ok, "results": results}, indent=2))
+        out = {"ok": ok, "results": results}
+        if sanitizer is not None:
+            out["sanitizer"] = sanitizer
+        print(json.dumps(out, indent=2))
     else:
         for r in results:
             status = "OK " if r.get("ok") else "FAIL"
@@ -86,6 +105,17 @@ def main(argv=None) -> int:
                 print(f"         t={ev['t']} {ev['event']} {ev['fault']}"
                       + "".join(f" {k}={v}" for k, v in ev.items()
                                 if k not in ("t", "event", "fault")))
+        if sanitizer is not None:
+            n = len(sanitizer["inversions"])
+            print(f"[{'OK ' if n == 0 else 'FAIL'}] lock-sanitizer "
+                  f"inversions={n} hold_outliers="
+                  f"{len(sanitizer['hold_outliers'])} "
+                  f"locks={sanitizer['locks_tracked']} "
+                  f"edges={sanitizer['edges']}")
+            for rec in sanitizer["inversions"]:
+                print(f"         {rec['first']} -> {rec['then']} at "
+                      f"{rec['acquire_site']} (reverse at "
+                      f"{rec['reverse_site']})")
     return 0 if ok else 1
 
 

@@ -29,13 +29,17 @@
    freshness windows) are allowlisted.
 
 Wired into tier-1 (tests/test_obslint.py) so a regression fails fast.
+
+File-walk, pragma, and CLI plumbing live in tools/lintcore.py, shared with
+racelint (the concurrency pass) so the two linters cannot drift.
 """
 
 from __future__ import annotations
 
 import ast
-import os
 import sys
+
+from chubaofs_tpu.tools import lintcore
 
 # label keys that smell like unbounded per-object ids
 BANNED_LABEL_KEYS = {
@@ -124,11 +128,8 @@ def lint_source(src: str, relpath: str) -> list[str]:
         # -- rule 4: latency/deadline arithmetic on the wall clock ----------
         if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Add, ast.Sub)) \
                 and (_is_walltime_call(node.left) or _is_walltime_call(node.right)) \
-                and not any(relpath.endswith(sfx)
-                            for sfx in ALLOWED_WALLCLOCK_FILES) \
-                and "wallclock:" not in (
-                    src_lines[node.lineno - 1]
-                    if 0 < node.lineno <= len(src_lines) else ""):
+                and not lintcore.path_matches(relpath, ALLOWED_WALLCLOCK_FILES) \
+                and not lintcore.has_pragma(src_lines, node.lineno, "wallclock"):
             # a `# wallclock: <why>` pragma documents the exception — wall
             # arithmetic that IS the protocol (e.g. a tx deadline riding a
             # raft proposal, compared by every replica)
@@ -156,40 +157,13 @@ def lint_source(src: str, relpath: str) -> list[str]:
 
 def run(root: str | None = None) -> list[str]:
     """Lint every .py file under the package; returns all findings."""
-    if root is None:
-        import chubaofs_tpu
-
-        root = os.path.dirname(os.path.abspath(chubaofs_tpu.__file__))
-    findings: list[str] = []
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
-        for fn in sorted(filenames):
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            rel = os.path.relpath(path, root)
-            with open(path, encoding="utf-8") as f:
-                findings.extend(lint_source(f.read(), rel))
-    return findings
+    return lintcore.run_package(lint_source, root)
 
 
 def main(argv=None) -> int:
-    import argparse
-
-    p = argparse.ArgumentParser(
-        prog="cfs-obslint",
-        description="lint metric-label cardinality + ad-hoc stats dicts")
-    p.add_argument("root", nargs="?", default=None,
-                   help="directory to lint (default: the installed package)")
-    args = p.parse_args(argv)
-    findings = run(args.root)
-    for f in findings:
-        print(f, file=sys.stderr)
-    if findings:
-        print(f"obslint: {len(findings)} finding(s)", file=sys.stderr)
-        return 1
-    print("obslint: clean")
-    return 0
+    return lintcore.lint_main(
+        "obslint", "lint metric-label cardinality + ad-hoc stats dicts",
+        run, argv)
 
 
 if __name__ == "__main__":

@@ -17,8 +17,9 @@ from __future__ import annotations
 import json
 import os
 import tempfile
-import threading
 import time
+
+from chubaofs_tpu.utils.locks import SanitizedLock
 
 
 class RotatingFile:
@@ -33,15 +34,16 @@ class RotatingFile:
         self.prefix = prefix
         self.max_bytes = max_bytes
         self.max_files = max_files
-        self._lock = threading.Lock()
+        # bounded name set: one rotor per trail kind (audit/slowop/traces/...)
+        self._lock = SanitizedLock(name=f"auditlog.{prefix}")
         os.makedirs(logdir, exist_ok=True)
         self._fh = None
-        self._open()
+        self._open_locked()
 
     def path(self, n: int = 0) -> str:
         return os.path.join(self.dir, f"{self.prefix}.log" + (f".{n}" if n else ""))
 
-    def _open(self):
+    def _open_locked(self):
         self._fh = open(self.path(), "a", encoding="utf-8")
         self._size = self._fh.tell()
 
@@ -51,7 +53,7 @@ class RotatingFile:
             src = self.path(n - 1) if n > 1 else self.path()
             if os.path.exists(src):
                 os.replace(src, self.path(n))
-        self._open()
+        self._open_locked()
 
     def write_line(self, line: str):
         with self._lock:
@@ -150,7 +152,7 @@ class SlowOpLog:
 
 
 _slowop: SlowOpLog | None = None
-_slowop_lock = threading.Lock()
+_slowop_lock = SanitizedLock(name="auditlog.slowop.default")
 
 
 _env_ms_cache: float | None = None

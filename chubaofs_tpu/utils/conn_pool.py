@@ -7,8 +7,9 @@ via put(ok=...) — broken sockets are dropped, healthy ones reused."""
 from __future__ import annotations
 
 import socket
-import threading
 import time
+
+from chubaofs_tpu.utils.locks import SanitizedLock
 
 
 class ConnPool:
@@ -18,7 +19,7 @@ class ConnPool:
         self.connect_timeout = connect_timeout
         self.io_timeout = io_timeout
         self._idle: dict[str, list[tuple[socket.socket, float]]] = {}
-        self._lock = threading.Lock()
+        self._lock = SanitizedLock(name="conn_pool.idle")
 
     @staticmethod
     def _split(addr: str) -> tuple[str, int]:

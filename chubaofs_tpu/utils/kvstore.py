@@ -24,6 +24,8 @@ import subprocess
 import threading
 import zlib
 
+from chubaofs_tpu.utils.locks import SanitizedLock
+
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native", "kvstore")
 _SO_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "build", "libcfskv.so"))
 
@@ -101,7 +103,7 @@ class NativeKV:
         self._h = lib.cfskv_open(path.encode(), err, len(err))
         if not self._h:
             raise KVError(f"open {path}: {err.value.decode()}")
-        self._lock = threading.Lock()
+        self._lock = SanitizedLock(name="kvstore.native")
 
     def _check(self, rc: int):
         if rc < 0:
@@ -197,7 +199,7 @@ class PyKV:
         self.index: dict[bytes, bytes] = {}
         self._live = 0
         self._total = 0
-        self._lock = threading.Lock()
+        self._lock = SanitizedLock(name="kvstore.pykv")
         ids = sorted(int(f[:8]) for f in os.listdir(path)
                      if len(f) == 12 and f.endswith(".log"))
         for i, fid in enumerate(ids):
@@ -264,7 +266,7 @@ class PyKV:
     def _frame(body: bytes) -> bytes:
         return _U32.pack(zlib.crc32(body)) + body
 
-    def _append(self, body: bytes):
+    def _append_locked(self, body: bytes):
         framed = self._frame(body)
         self._f.write(framed)
         self._f.flush()
@@ -272,7 +274,7 @@ class PyKV:
 
     def put(self, key: bytes, value: bytes) -> None:
         with self._lock:
-            self._append(_SUB.pack(_PUT, len(key), len(value)) + key + value)
+            self._append_locked(_SUB.pack(_PUT, len(key), len(value)) + key + value)
             self._apply(_PUT, key, value)
             self._maybe_compact()
 
@@ -282,7 +284,7 @@ class PyKV:
 
     def delete(self, key: bytes) -> None:
         with self._lock:
-            self._append(_SUB.pack(_DEL, len(key), 0) + key)
+            self._append_locked(_SUB.pack(_DEL, len(key), 0) + key)
             self._apply(_DEL, key, b"")
             self._maybe_compact()
 
@@ -299,7 +301,7 @@ class PyKV:
             return
         with self._lock:
             body = _SUB.pack(_BATCH, count, len(payload)) + bytes(payload)
-            self._append(body)
+            self._append_locked(body)
             self._apply_body(body)
             self._maybe_compact()
 

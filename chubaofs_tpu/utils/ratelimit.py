@@ -9,8 +9,9 @@ buckets.
 
 from __future__ import annotations
 
-import threading
 import time
+
+from chubaofs_tpu.utils.locks import SanitizedLock
 
 
 class RateLimitExceeded(Exception):
@@ -29,9 +30,9 @@ class TokenBucket:
         self.burst = float(burst if burst is not None else max(rate, 1.0))
         self._tokens = self.burst
         self._last = time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = SanitizedLock(name="ratelimit.bucket")
 
-    def _refill(self, now: float) -> None:
+    def _refill_locked(self, now: float) -> None:
         self._tokens = min(self.burst, self._tokens + (now - self._last) * self.rate)
         self._last = now
 
@@ -40,7 +41,7 @@ class TokenBucket:
             return True
         with self._lock:
             now = time.monotonic()
-            self._refill(now)
+            self._refill_locked(now)
             if self._tokens >= n:
                 self._tokens -= n
                 return True
@@ -56,7 +57,7 @@ class TokenBucket:
         while True:
             with self._lock:
                 now = time.monotonic()
-                self._refill(now)
+                self._refill_locked(now)
                 if self._tokens >= n:
                     self._tokens -= n
                     return True
@@ -77,7 +78,7 @@ class KeyedLimiter:
     """
 
     def __init__(self, rates: dict | None = None, default: float = 0.0):
-        self._lock = threading.Lock()
+        self._lock = SanitizedLock(name="ratelimit.keyed")
         self._buckets: dict[str, TokenBucket] = {}
         self._rates = dict(rates or {})
         self._default = default

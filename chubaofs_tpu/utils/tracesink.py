@@ -28,10 +28,10 @@ import collections
 import json
 import os
 import tempfile
-import threading
 import zlib
 
 from chubaofs_tpu.utils.auditlog import RotatingFile
+from chubaofs_tpu.utils.locks import SanitizedLock
 
 
 class TraceSink:
@@ -46,7 +46,7 @@ class TraceSink:
         self.dir = logdir
         self._rotor = RotatingFile(logdir, "traces", max_bytes, max_files)
         self._recent: collections.deque = collections.deque(maxlen=recent_max)
-        self._lock = threading.Lock()
+        self._lock = SanitizedLock(name="tracesink.recent")
 
     # -- ingest ----------------------------------------------------------------
 
@@ -142,7 +142,7 @@ class TraceSink:
 # -- process-wide default ------------------------------------------------------
 
 _default: TraceSink | None = None
-_lock = threading.Lock()
+_lock = SanitizedLock(name="tracesink.default")
 
 
 def _env_sample() -> float:

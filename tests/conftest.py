@@ -8,6 +8,12 @@ XLA's host-platform device partitioning. Must run before jax is imported anywher
 import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Arm the lock-order sanitizer for the WHOLE suite (subprocess daemons
+# inherit it via the harness env): every MiniCluster/ProcCluster e2e then
+# doubles as a race/deadlock probe. utils/locks.py checks this at lock
+# construction, so it must be set before any chubaofs_tpu import below.
+# Export CFS_LOCK_SANITIZER=0 to measure un-instrumented timings.
+os.environ.setdefault("CFS_LOCK_SANITIZER", "1")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -198,6 +198,18 @@ def test_wedged_node_does_not_stall_puts(tmp_path, rng):
         assert first < 5.0, f"PUT stalled {first:.1f}s behind the wedged node"
         assert c.access.get(loc) == data
 
+        # the punish lands asynchronously when the wedged shard write times
+        # out at write_deadline (the first PUT already returned via quorum);
+        # wait for it so the timed PUT below measures the punished fast-fail
+        # path, not this race
+        wedged_disk = next(u.disk_id for u in vol.units
+                           if u.node_id == wedged_id)
+        deadline = time.monotonic() + 10.0
+        while (not c.access._is_punished(wedged_disk)
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        assert c.access._is_punished(wedged_disk), "wedged disk never punished"
+
         # wedged disk now punished: a second PUT fails that shard fast
         t0 = time.monotonic()
         loc2 = c.access.put(blob_bytes(rng, 600_000))
