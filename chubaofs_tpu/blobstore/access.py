@@ -828,17 +828,29 @@ class Access:
     def _read_shard(
         self, vol: VolumeInfo, idx: int, bid: int, offset: int, size: int
     ) -> bytes | None:
+        from chubaofs_tpu.blobstore.blobnode import classify_io_error
+
         unit = vol.units[idx]
         node = self.nodes.get(unit.node_id)
         if node is None:
+            registry("access").counter(
+                "read_fail", {"reason": "no_node"}).add()
             return None
         try:
             chaos.failpoint("access.read_shard", node=unit.node_id)
             data = node.get_shard(unit.vuid, bid, offset=offset, size=size)
             if len(data) != size:
+                registry("access").counter(
+                    "read_fail", {"reason": "short"}).add()
                 return None
             return data
-        except Exception:
+        except Exception as e:
+            # the caller's contract stays None-on-failure (degraded path
+            # reconstructs around it) but the CLASS of failure is no longer
+            # discarded: a fleet of {timeout}s and a fleet of {error}s need
+            # different pages (same taxonomy as scheduler probe_fail)
+            registry("access").counter(
+                "read_fail", {"reason": classify_io_error(e)}).add()
             return None
 
     def _read_blob_degraded(self, t, vol, blob, shard_len, offset, size,

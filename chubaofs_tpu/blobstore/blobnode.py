@@ -34,6 +34,7 @@ import zlib
 from dataclasses import dataclass
 
 from chubaofs_tpu import chaos
+from chubaofs_tpu.blobstore.clustermgr import DISK_BROKEN, DISK_NORMAL
 from chubaofs_tpu.utils import crc32block
 from chubaofs_tpu.utils.locks import SanitizedLock
 from chubaofs_tpu.utils.kvstore import open_kv
@@ -70,6 +71,27 @@ class NoSuchShard(BlobNodeError):
 
 class ChunkFull(BlobNodeError):
     pass
+
+
+def classify_io_error(e: BaseException) -> str:
+    """Bucket a shard-IO failure for {reason}-labeled metrics: 'missing'
+    (routine absence — the shard was never written or already lost),
+    'timeout' (a silent hang that hit a deadline), 'io' (infrastructure:
+    sockets, disks, injected faults), or 'error' (everything else — the
+    bucket that should be a bug). The split is what makes a wedged node and
+    a real defect distinguishable on a dashboard."""
+    from concurrent.futures import TimeoutError as _FutTimeout
+
+    from chubaofs_tpu.chaos.failpoints import Dropped, FailpointError
+
+    if isinstance(e, NoSuchShard):
+        return "missing"
+    if isinstance(e, (TimeoutError, _FutTimeout)):
+        return "timeout"
+    if isinstance(e, (BlobNodeError, OSError, ConnectionError,
+                      FailpointError, Dropped)):
+        return "io"
+    return "error"
 
 
 @dataclass
@@ -425,7 +447,7 @@ class BlobNode:
     """
 
     def __init__(self, node_id: int, disk_roots: list[str],
-                 iostat: bool = False):
+                 iostat: bool = False, scrub_rate: float | None = None):
         self.node_id = node_id
         self.disks: dict[int, Disk] = {}
         for i, root in enumerate(disk_roots):
@@ -449,6 +471,36 @@ class BlobNode:
             for cid in d.chunks:
                 if cid.startswith("vuid-"):
                     self._chunk_of_vuid[int(cid[5:])] = (d.disk_id, cid)
+        # -- detection state (datainspect.go + disk-failure reporting) -------
+        # scrub: token-bucket byte budget (CFS_SCRUB_RATE bytes/s; 0 =
+        # unlimited) + a resumable (vuid, bid) cursor persisted in the first
+        # disk's metadb, so a restarted node continues mid-sweep instead of
+        # rescanning from shard zero
+        if scrub_rate is None:
+            scrub_rate = float(os.environ.get("CFS_SCRUB_RATE",
+                                              str(64 << 20)))
+        self._scrub_bucket = None
+        if scrub_rate > 0:
+            from chubaofs_tpu.utils.ratelimit import TokenBucket
+
+            self._scrub_bucket = TokenBucket(scrub_rate)
+        self._scrub_db = (self.disks[min(self.disks)].metadb
+                          if self.disks else None)
+        self._scrub_cursor: tuple[int, int] | None = None
+        if self._scrub_db is not None:
+            raw = self._scrub_db.get(b"scrub/cursor")
+            if raw:
+                try:
+                    v, b = json.loads(raw)
+                    self._scrub_cursor = (int(v), int(b))
+                except (ValueError, TypeError):
+                    # bad JSON raises ValueError, but valid-JSON garbage (a
+                    # scalar, an object) fails the unpack with TypeError —
+                    # either way: restart the sweep, lose nothing
+                    pass
+        # consecutive IO errors per disk: the heartbeat's disk-failure signal
+        self._io_errors: dict[int, int] = {}
+        self._closed = False
 
     # -- chunk lifecycle (clustermgr drives this) ---------------------------
 
@@ -472,6 +524,36 @@ class BlobNode:
         disk_id, cid = loc
         return self.disks[disk_id].chunks[cid]
 
+    def _disk_io(self, vuid: int, op):
+        """Run one chunk op tracking CONSECUTIVE per-disk OSErrors — the
+        disk-failure signal heartbeat() reports to clustermgr. Logical
+        faults (NoSuchShard, CRC mismatches) don't count: a dying device
+        shows up as the OS refusing IO, not as absent bids."""
+        loc = self._chunk_of_vuid.get(vuid)
+        before = self._io_errors.get(loc[0], 0) if loc is not None else 0
+        try:
+            out = op()
+        except OSError:
+            if loc is not None:
+                # under the node lock: concurrent failing reads (access
+                # fan-out, repair pool, scrub) must not lose increments of
+                # the CONSECUTIVE count heartbeat's broken_after gates on
+                with self._lock:
+                    self._io_errors[loc[0]] = \
+                        self._io_errors.get(loc[0], 0) + 1
+                self._reg.counter("disk_io_errors").add()
+            raise
+        if loc is not None and before:
+            with self._lock:
+                # a success breaks the consecutive chain — but only reset if
+                # the count is still the one we snapshotted: failures that
+                # landed WHILE this op was in flight are newer information,
+                # and zeroing them would lose increments the except path
+                # took the lock to keep
+                if self._io_errors.get(loc[0], 0) == before:
+                    self._io_errors[loc[0]] = 0
+        return out
+
     # -- shard API ----------------------------------------------------------
 
     def put_shard(self, vuid: int, bid: int, payload: bytes) -> None:
@@ -488,7 +570,8 @@ class BlobNode:
                 # repair catches it
                 payload = chaos.corrupt_bytes("blobnode.put_shard.payload",
                                               payload, node=self.node_id)
-                self._chunk(vuid).put(bid, vuid, payload)
+                self._disk_io(
+                    vuid, lambda: self._chunk(vuid).put(bid, vuid, payload))
             self._reg.counter("shard_put_bytes_total").add(len(payload))
         finally:
             if self._iostat is not None:
@@ -505,7 +588,8 @@ class BlobNode:
         try:
             with self._reg.tp("shard_get"):
                 chaos.failpoint("blobnode.get_shard", node=self.node_id)
-                data = self._chunk(vuid).get(bid, offset, size)
+                data = self._disk_io(
+                    vuid, lambda: self._chunk(vuid).get(bid, offset, size))
             self._reg.counter("shard_get_bytes_total").add(len(data))
             # corrupt-on-read models wire/DMA corruption past the CRC framing
             return chaos.corrupt_bytes("blobnode.get_shard.data", data,
@@ -582,7 +666,8 @@ class BlobNode:
 
     def inspect_once(self) -> list[tuple[int, int]]:
         """CRC scrub (blobnode/datainspect.go): re-read every live shard
-        through the crc32block framing; returns [(vuid, bid)] that fail."""
+        through the crc32block framing; returns [(vuid, bid)] that fail.
+        The one-shot full sweep; the production loop is scrub_once()."""
         bad: list[tuple[int, int]] = []
         for vuid, (disk_id, cid) in list(self._chunk_of_vuid.items()):
             chunk = self.disks[disk_id].chunks.get(cid)
@@ -597,7 +682,114 @@ class BlobNode:
                     bad.append((vuid, meta.bid))
         return bad
 
+    def _scrub_positions(self, cur: tuple[int, int] | None):
+        """Live shard positions strictly AFTER the cursor, chunk by chunk
+        in (vuid, bid) order — the batched-per-chunk iteration scrub_once
+        resumes through."""
+        for vuid in sorted(self._chunk_of_vuid):
+            if cur is not None and vuid < cur[0]:
+                continue
+            loc = self._chunk_of_vuid.get(vuid)
+            if loc is None:
+                continue
+            chunk = self.disks[loc[0]].chunks.get(loc[1])
+            if chunk is None:
+                continue
+            for meta in chunk.list_shards():
+                if cur is not None and vuid == cur[0] and meta.bid <= cur[1]:
+                    continue
+                if meta.status == STATUS_NORMAL:
+                    yield vuid, meta.bid, chunk, meta
+
+    def _save_scrub_cursor(self) -> None:
+        if self._scrub_db is None:
+            return
+        try:
+            if self._scrub_cursor is None:
+                self._scrub_db.delete(b"scrub/cursor")
+            else:
+                self._scrub_db.put(b"scrub/cursor",
+                                   json.dumps(list(self._scrub_cursor)).encode())
+        except Exception:
+            pass  # a cursor that fails to persist restarts the sweep, no worse
+
+    def scrub_once(self, max_shards: int = 256) -> dict:
+        """One budgeted tick of the background CRC scrub loop: re-read up to
+        max_shards live shards through their crc32block framing, resuming
+        from the persisted cursor, spending at most the CFS_SCRUB_RATE
+        token-bucket byte budget. Returns {"scanned", "bad": [(vuid, bid)],
+        "complete"} — complete=True means the sweep wrapped (the cursor
+        reset) and everything currently live was verified this cycle."""
+        scanned = 0
+        bad: list[tuple[int, int]] = []
+        complete = False
+        exhausted = True  # ran off the end of the shard list (vs budget)
+        for vuid, bid, chunk, meta in self._scrub_positions(self._scrub_cursor):
+            if scanned >= max_shards:
+                exhausted = False
+                break
+            cost = HEADER_LEN + crc32block.encoded_len(meta.size)
+            if self._scrub_bucket is not None and not \
+                    self._scrub_bucket.try_acquire(
+                        min(cost, self._scrub_bucket.burst)):
+                exhausted = False  # byte budget dry: resume here next tick
+                break
+            try:
+                self._disk_io(vuid, lambda: chunk.get(bid))
+            except OSError:
+                # the OS refusing IO is a DISK failure (heartbeat's
+                # consecutive-error signal, counted by _disk_io), not
+                # bitrot — repairing shard-by-shard off a dying device
+                # would fight the disk-repair migration
+                pass
+            except Exception:
+                bad.append((vuid, bid))
+            scanned += 1
+            self._scrub_cursor = (vuid, bid)
+        if exhausted:
+            # wrapped: a full pass over every live shard finished
+            if self._scrub_cursor is not None:
+                self._reg.counter("scrub_sweeps").add()
+            complete = True
+            self._scrub_cursor = None
+        self._save_scrub_cursor()
+        if scanned:
+            self._reg.counter("scrub_scanned_shards").add(scanned)
+        if bad:
+            self._reg.counter("scrub_bad_shards").add(len(bad))
+        return {"scanned": scanned, "bad": bad, "complete": complete}
+
+    def heartbeat(self, cm, broken_after: int = 3) -> None:
+        """Report per-disk liveness + chunk counts to clustermgr, flagging
+        any disk whose consecutive IO-error count crossed broken_after as
+        BROKEN (the disk-failure half of detection; heartbeats going SILENT
+        — a dead process — is caught by the clustermgr-side expiry)."""
+        if self._closed:
+            # a dead engine must go SILENT: heartbeat itself touches no disk
+            # IO, so without this gate a crashed-but-still-routed node (the
+            # chaos crash plan closes the engine in place) would keep
+            # beating and the expiry path could never detect it
+            return
+        for disk_id, disk in self.disks.items():
+            if self._io_errors.get(disk_id, 0) >= broken_after:
+                try:
+                    # only flip a NORMAL disk: re-reporting a DROPPED disk
+                    # (repair done, error count never reset) as broken would
+                    # mint an endless broken->repair->dropped->broken cycle
+                    if cm.disk_status(disk_id) == DISK_NORMAL:
+                        cm.set_disk_status(disk_id, DISK_BROKEN)
+                except Exception:
+                    pass  # control plane unreachable: retried next beat
+                continue  # a broken disk stops heartbeating as healthy
+            try:
+                # no chunk_count: clustermgr's unit accounting is
+                # authoritative (physical chunks lag volume creation)
+                cm.heartbeat_disk(disk_id)
+            except Exception:
+                pass
+
     def close(self):
+        self._closed = True
         for d in self.disks.values():
             d.close()
         if self._iostat is not None:

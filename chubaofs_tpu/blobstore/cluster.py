@@ -51,7 +51,19 @@ class MiniCluster:
         self.worker = RepairWorker(self.scheduler, self.nodes, codec=self.codec)
 
     def run_background_once(self) -> dict:
-        """One tick of every background loop (the 16-ticker scheduleTask analog)."""
+        """One tick of every background loop (the 16-ticker scheduleTask analog):
+        detection first (heartbeats, heartbeat expiry, lease reaping, the
+        budgeted scrub), then the task planes, then host-local hygiene."""
+        # heartbeats are per-node daemon work: a dead/closed engine simply
+        # stops beating, which IS the signal the expiry below consumes
+        for n in list(self.nodes.values()):
+            try:
+                n.heartbeat(self.cm)
+            except Exception:
+                pass
+        dead_disks = self.scheduler.check_node_health()
+        reaped = self.scheduler.reap_expired()
+        scrubbed = self.scheduler.run_scrub()
         inspected = self.scheduler.inspect_volumes()
         polled = self.scheduler.poll_repair_topic()
         disk_tasks = self.scheduler.check_disks()
@@ -76,12 +88,16 @@ class MiniCluster:
             "tasks_ran": ran,
             "deletes": deleted,
             "compacted_bytes": compacted,
+            "hb_expired_disks": len(dead_disks),
+            "leases_reaped": reaped,
+            "scrub_findings": scrubbed,
         }
 
     def close(self):
         if self._owns_codec:  # never kill a shared/injected service
             self.codec.close()
         self.access.close()
+        self.worker.close()
         for node in self.nodes.values():
             node.close()
         self.cm.close()

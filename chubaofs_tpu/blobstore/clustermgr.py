@@ -89,6 +89,12 @@ class ClusterMgr:
         self.scopes: dict[str, int] = {}
         self.services: dict[str, list[str]] = {}
         self.config: dict[str, str] = {}
+        # monotonic heartbeat observations, THIS process only (never
+        # persisted — a wall-clock stamp would be meaningless arithmetic
+        # across restarts, and expiry is a liveness judgment about what this
+        # clustermgr has itself observed). Restored disks stamp "now" so a
+        # freshly-loaded cluster gets a full grace window before any expiry.
+        self._hb_mono: dict[int, float] = {}
         self._data_dir = data_dir
         self._db = None
         self._seq = 0  # last applied wal sequence
@@ -164,6 +170,8 @@ class ClusterMgr:
 
     def _restore(self, snap: dict):
         self.disks = {int(i): DiskInfo(**d) for i, d in snap["disks"].items()}
+        now = time.monotonic()
+        self._hb_mono = {i: now for i in self.disks}
         self.volumes = {}
         for v, info in snap["volumes"].items():
             units = [VolumeUnit(**u) for u in info.pop("units")]
@@ -246,16 +254,42 @@ class ClusterMgr:
         if disk_id not in self.disks:  # racelint: _op_* appliers only run under self._lock (apply/_apply_batch take it)
             self.disks[disk_id] = DiskInfo(disk_id, node_id, az, rack)
         self.disks[disk_id].last_heartbeat = time.time()
+        self._hb_mono[disk_id] = time.monotonic()  # racelint: _op_* appliers only run under self._lock (apply/_apply_batch take it)
 
-    def heartbeat_disk(self, disk_id: int, chunk_count: int = 0) -> None:
-        self.apply("heartbeat_disk", {"disk_id": disk_id, "chunk_count": chunk_count})
+    def heartbeat_disk(self, disk_id: int,
+                       chunk_count: int | None = None) -> None:
+        """Liveness beat. NOT an apply(): heartbeats are observations, not
+        replicated state transitions — a WAL entry per beat per disk would
+        bloat the log for zero recovery value (the reference batches them
+        in memory the same way). chunk_count=None leaves the placement
+        bookkeeping alone: clustermgr's own unit accounting is
+        authoritative, and a node's physical chunk count legitimately lags
+        volume creation (chunks materialize at first write)."""
+        with self._lock:
+            d = self.disks.get(disk_id)
+            if d is None:
+                raise ClusterError(f"unknown disk {disk_id}")
+            d.last_heartbeat = time.time()
+            self._hb_mono[disk_id] = time.monotonic()
+            if chunk_count is not None:
+                d.chunk_count = chunk_count
 
     def _op_heartbeat_disk(self, disk_id: int, chunk_count: int):
+        # retained for WAL replay of pre-heartbeat-rework logs
         d = self.disks.get(disk_id)
         if d is None:
             raise ClusterError(f"unknown disk {disk_id}")
         d.last_heartbeat = time.time()
+        self._hb_mono[disk_id] = time.monotonic()  # racelint: _op_* appliers only run under self._lock (apply/_apply_batch take it)
         d.chunk_count = chunk_count
+
+    def disk_status(self, disk_id: int) -> str | None:
+        """Current status of one disk (None if unknown) — the read half of
+        the report-broken handshake: a reporter must not flip a disk that
+        already left NORMAL (broken is being repaired, dropped IS repaired)."""
+        with self._lock:
+            d = self.disks.get(disk_id)
+            return None if d is None else d.status
 
     def set_disk_status(self, disk_id: int, status: str) -> None:
         self.apply("set_disk_status", {"disk_id": disk_id, "status": status})
@@ -422,6 +456,26 @@ class ClusterMgr:
     def broken_disks(self) -> list[DiskInfo]:
         with self._lock:
             return [d for d in self.disks.values() if d.status == DISK_BROKEN]
+
+    def expire_heartbeats(self, timeout_s: float) -> list[int]:
+        """Mark NORMAL disks whose heartbeat this process hasn't observed in
+        timeout_s as BROKEN (the kill-a-blobnode detection path: a dead
+        engine stops beating and its disks become disk-repair work). The
+        judgment clock is monotonic and process-local — a restarted
+        clustermgr grants every disk a fresh grace window rather than
+        condemning the fleet off stale wall-clock stamps. Returns the disk
+        ids newly marked broken (the status change IS replicated)."""
+        now = time.monotonic()
+        with self._lock:
+            stale = [
+                d.disk_id for d in self.disks.values()
+                if d.status == DISK_NORMAL
+                and now - self._hb_mono.get(d.disk_id, now) > timeout_s
+            ]
+            for disk_id in stale:
+                self._apply("set_disk_status",
+                            {"disk_id": disk_id, "status": DISK_BROKEN})
+        return stale
 
     def volumes_on_disk(self, disk_id: int) -> list[tuple[VolumeInfo, VolumeUnit]]:
         with self._lock:

@@ -18,18 +18,24 @@ via HTTPTaskAcquire, service.go:84, repair tasks served first). Shapes kept:
 from __future__ import annotations
 
 import json
+import os
 import threading
 import time
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+from concurrent.futures import TimeoutError as FutureTimeout
 from dataclasses import dataclass, field
 
 import numpy as np
 
-from chubaofs_tpu.blobstore.blobnode import BlobNode
+from chubaofs_tpu.blobstore import trace
+from chubaofs_tpu.blobstore.blobnode import BlobNode, classify_io_error
 from chubaofs_tpu.blobstore.clustermgr import (
     DISK_DROPPED,
     DISK_NORMAL,
     ClusterMgr,
     VolumeInfo,
+    parse_vuid,
 )
 from chubaofs_tpu.blobstore.proxy import (
     TOPIC_BLOB_DELETE,
@@ -37,7 +43,7 @@ from chubaofs_tpu.blobstore.proxy import (
     Proxy,
 )
 from chubaofs_tpu.codec.service import CodecService, default_service
-from chubaofs_tpu.utils.exporter import registry
+from chubaofs_tpu.utils.exporter import BATCH_BUCKETS, RATIO_BUCKETS, registry
 
 TASK_PREPARED = "prepared"
 TASK_WORKING = "working"
@@ -51,6 +57,20 @@ KIND_BALANCE = "balance"
 
 # acquisition priority (service.go:84: repair first)
 _PRIORITY = [KIND_SHARD_REPAIR, KIND_DISK_REPAIR, KIND_DISK_DROP, KIND_BALANCE]
+
+_TASK_STATES = (TASK_PREPARED, TASK_WORKING, TASK_FINISHED, TASK_FAILED)
+
+
+def stage_overlap_ratio(stages) -> float | None:
+    """Download/decode overlap of one repair span's stages: intersection of
+    the 'download' interval union with the codec.* interval union, over the
+    SMALLER of the two — 0 means the pipeline degenerated to serial, >0 means
+    survivor downloads really ran while the device decoded. None when either
+    side never happened (nothing to overlap)."""
+    dl = [(off, off + dur) for name, off, dur in stages if name == "download"]
+    dec = [(off, off + dur) for name, off, dur in stages
+           if name.startswith("codec.")]
+    return trace.overlap_ratio(dl, dec)
 
 
 @dataclass
@@ -66,6 +86,10 @@ class Task:
     created: float = field(default_factory=time.time)
     retries: int = 0
     error: str = ""
+    # current lease number (0 = never leased). Monotonic across the
+    # scheduler's lifetime; a report carrying an older lease is STALE — the
+    # reaper requeued and re-leased the task after that worker went quiet.
+    lease: int = 0
 
 
 class Scheduler:
@@ -89,7 +113,25 @@ class Scheduler:
         self._tasks: dict[str, Task] = {}
         self._seq = 0
         self._inspect_cursor = 0  # round-robin position over volume ids
+        # leased scheduling (the task_runner.go lease/renewal analog): every
+        # acquire hands out a monotonic deadline; the reaper requeues expired
+        # WORKING tasks with backoff so a dead worker can never strand one.
+        self.lease_ms = float(os.environ.get("CFS_REPAIR_LEASE_MS", "30000"))
+        self.requeue_backoff_s = 0.5  # doubled per expiry, capped below
+        self.requeue_backoff_cap_s = 30.0
+        # expiries before a WORKING task goes terminal FAILED (reap_expired)
+        self.max_lease_expiries = 5
+        # heartbeat-silence window after which a disk counts as dead (the
+        # kill-a-blobnode detection path; generous default so slow test
+        # phases never false-positive — the kill soak tightens it)
+        self.hb_timeout_s = float(os.environ.get("CFS_HB_TIMEOUT_S", "60"))
+        self._lease_seq = 0
+        self._lease_deadline: dict[str, float] = {}  # task_id -> monotonic
+        self._not_before: dict[str, float] = {}      # requeue backoff gate
+        self._expiries: dict[str, int] = {}          # per-task expiry count
         self._load_tasks()
+        with self._lock:
+            self._update_gauges_locked()
 
     # -- task table (persisted in the clustermgr config KV, the reference's
     # migrate-task tables in clustermgr: migrate.go:346-347) -------------------
@@ -137,6 +179,9 @@ class Scheduler:
             if t.state == TASK_WORKING:
                 t.state = TASK_PREPARED
             self._tasks[t.task_id] = t
+            # lease numbers stay monotonic across reloads so a pre-crash
+            # worker's report can never alias a fresh lease
+            self._lease_seq = max(self._lease_seq, t.lease)
 
     def _persist_task(self, t: Task):
         key = self._TASK_PREFIX + t.task_id
@@ -154,6 +199,7 @@ class Scheduler:
             t = Task(task_id=f"t{self._seq}", **kw)
             self._tasks[t.task_id] = t
             self._persist_task(t)
+            self._update_gauges_locked()
             return t
 
     def tasks(self, kind: str | None = None, state: str | None = None) -> list[Task]:
@@ -357,41 +403,198 @@ class Scheduler:
     # -- worker pull API (HTTPTaskAcquire analog) -----------------------------
 
     def acquire_task(self) -> Task | None:
+        """Hand out the highest-priority PREPARED task under a LEASE: the
+        returned task carries a fresh lease number and a monotonic deadline;
+        a worker that never reports is reaped by reap_expired() and the task
+        requeues with backoff. Capture task.lease IMMEDIATELY — the shared
+        Task object's lease advances if the task is ever re-leased."""
+        now = time.monotonic()
         with self._lock:
             for kind in _PRIORITY:
                 for t in self._tasks.values():
-                    if t.kind == kind and t.state == TASK_PREPARED:
-                        # WORKING is NOT persisted: reload demotes it back to
-                        # PREPARED anyway, so the write would buy nothing
-                        t.state = TASK_WORKING
-                        return t
+                    if t.kind != kind or t.state != TASK_PREPARED:
+                        continue
+                    if self._not_before.get(t.task_id, 0.0) > now:
+                        continue  # requeue backoff still cooling
+                    t.state = TASK_WORKING
+                    self._lease_seq += 1
+                    t.lease = self._lease_seq
+                    # persisted not for the WORKING state (reload demotes it
+                    # back to PREPARED regardless) but for the LEASE number:
+                    # _load_tasks restores _lease_seq from the stored maximum,
+                    # so a worker that outlives a scheduler crash can never
+                    # find its old lease number reissued to someone else
+                    self._persist_task(t)
+                    self._lease_deadline[t.task_id] = \
+                        now + self.lease_ms / 1e3
+                    self._update_gauges_locked()
+                    return t
         return None
 
-    def report_task(self, task_id: str, ok: bool, error: str = "") -> None:
+    def reap_expired(self) -> int:
+        """Requeue WORKING tasks whose lease deadline passed (the junk-task
+        cleanup loop the reference runs against dead workers): state back to
+        PREPARED behind an exponential requeue backoff, counted by
+        cfs_scheduler_lease_expired. The late worker's eventual report is
+        dropped as stale (its lease no longer matches). A task that expires
+        max_lease_expiries times goes terminal FAILED instead — workers
+        renew mid-task (renew_lease), so repeated expiry means every
+        execution dies, and re-executing forever is not an error path."""
+        now = time.monotonic()
+        reaped = 0
+        failed = 0
         with self._lock:
-            t = self._tasks[task_id]
-            if ok:
-                t.state = TASK_FINISHED
-            else:
-                t.retries += 1
-                t.error = error
-                t.state = TASK_PREPARED if t.retries < 3 else TASK_FAILED
-            self._persist_task(t)
-            if t.state in (TASK_FINISHED, TASK_FAILED):
+            for t in self._tasks.values():
+                if t.state != TASK_WORKING:
+                    continue
+                deadline = self._lease_deadline.get(t.task_id)
+                if deadline is not None and now < deadline:
+                    continue
+                self._lease_deadline.pop(t.task_id, None)
+                n = self._expiries.get(t.task_id, 0) + 1
+                self._expiries[t.task_id] = n
+                if n >= self.max_lease_expiries:
+                    t.state = TASK_FAILED
+                    t.error = f"lease expired {n}x with no report"
+                    self._persist_task(t)
+                    self._not_before.pop(t.task_id, None)
+                    self._expiries.pop(t.task_id, None)
+                    failed += 1
+                else:
+                    t.state = TASK_PREPARED
+                    self._not_before[t.task_id] = now + min(
+                        self.requeue_backoff_cap_s,
+                        self.requeue_backoff_s * (2 ** (n - 1)))
+                reaped += 1
+            if failed:
                 self._prune_terminal_locked()
+            if reaped:
+                self._update_gauges_locked()
+        if reaped:
+            registry("scheduler").counter("lease_expired").add(reaped)
+        if failed:
+            registry("scheduler").counter("lease_expired_failed").add(failed)
+        return reaped
+
+    def renew_lease(self, task_id: str, lease: int) -> bool:
+        """Extend a WORKING task's lease deadline by a full lease_ms (the
+        reference task runner's renewal tick). A long disk migrate renews
+        between units so a healthy slow worker never loses a race against
+        the reaper; False means the lease is gone (task pruned, reaped, or
+        re-leased) and the caller must abandon the task."""
+        with self._lock:
+            t = self._tasks.get(task_id)
+            if t is None or t.state != TASK_WORKING or t.lease != lease:
+                return False
+            self._lease_deadline[task_id] = \
+                time.monotonic() + self.lease_ms / 1e3
+        registry("scheduler").counter("lease_renewed").add()
+        return True
+
+    def report_task(self, task_id: str, ok: bool, error: str = "",
+                    lease: int | None = None) -> bool:
+        """Worker completion report. Tolerant by contract: an unknown id
+        (terminal-task pruning, scheduler reload), a task no longer WORKING
+        (the reaper requeued it), or a mismatched lease (it was re-leased to
+        another worker) is DROPPED with cfs_scheduler_stale_report — never a
+        crash in the worker thread, and never a double state transition.
+        Returns True when the report was accepted."""
+        with self._lock:
+            t = self._tasks.get(task_id)
+            stale = (t is None or t.state != TASK_WORKING
+                     or (lease is not None and lease != t.lease))
+            if stale:
+                reason = ("pruned" if t is None else
+                          "not_working" if t.state != TASK_WORKING
+                          else "lease")
+            else:
+                self._lease_deadline.pop(task_id, None)
+                if ok:
+                    t.state = TASK_FINISHED
+                else:
+                    t.retries += 1
+                    t.error = error
+                    t.state = TASK_PREPARED if t.retries < 3 else TASK_FAILED
+                self._persist_task(t)
+                if t.state in (TASK_FINISHED, TASK_FAILED):
+                    self._prune_terminal_locked()
+                    self._not_before.pop(task_id, None)
+                    self._expiries.pop(task_id, None)
+                self._update_gauges_locked()
             record = None
-            if self.record_log is not None and t.state in (TASK_FINISHED, TASK_FAILED):
+            if not stale and self.record_log is not None \
+                    and t.state in (TASK_FINISHED, TASK_FAILED):
                 record = {
                     "task_id": t.task_id, "kind": t.kind, "state": t.state,
                     "vid": t.vid, "bid": t.bid, "disk_id": t.disk_id,
                     "retries": t.retries, "error": t.error,
                 }
+        if stale:
+            registry("scheduler").counter(
+                "stale_report", {"reason": reason}).add()
+            return False
         # record outside the lock; the audit trail must never alter task state
         if record is not None:
             try:
                 self.record_log.encode(record)
             except OSError:
                 pass
+        return True
+
+    def _update_gauges_locked(self) -> None:
+        """cfs_scheduler_tasks{kind,state} gauges over the (bounded) table —
+        the cfs-stat repair rollup's task inventory."""
+        counts: dict[tuple[str, str], int] = {}
+        for t in self._tasks.values():
+            counts[(t.kind, t.state)] = counts.get((t.kind, t.state), 0) + 1
+        reg = registry("scheduler")
+        for kind in _PRIORITY:
+            for state in _TASK_STATES:
+                reg.gauge("tasks", {"kind": kind, "state": state}).set(
+                    counts.get((kind, state), 0))
+
+    # -- detection drivers (scrub + heartbeat expiry) -------------------------
+
+    def run_scrub(self, max_shards: int = 256) -> int:
+        """One budgeted scrub tick across every reachable blobnode: each
+        node re-reads up to max_shards live shards through its crc32block
+        framing (cursor-resumable, CFS_SCRUB_RATE-limited — see
+        BlobNode.scrub_once) and every CRC failure feeds the repair topic.
+        This is the datainspect.go half of detection: it finds bitrot
+        without waiting for a client GET or a full inspector sweep."""
+        from chubaofs_tpu.blobstore.taskswitch import SWITCH_VOL_INSPECT
+
+        if not self.switches.enabled(SWITCH_VOL_INSPECT):
+            return 0
+        produced = 0
+        for node in list(self.nodes.values()):
+            try:
+                res = node.scrub_once(max_shards=max_shards)
+            except Exception:
+                continue  # dark/closed engine: its restart resumes the cursor
+            for vuid, bid in res["bad"]:
+                vid, idx, _ = parse_vuid(vuid)
+                try:
+                    self.proxy.send_shard_repair(vid, bid, [idx], "scrub")
+                    produced += 1
+                except Exception:
+                    pass  # proxy down: the next sweep re-finds it
+        if produced:
+            registry("scheduler").counter("scrub_findings").add(produced)
+        return produced
+
+    def check_node_health(self, timeout_s: float | None = None) -> list[int]:
+        """Mark disks whose heartbeats went silent as BROKEN (the
+        kill-a-blobnode detection path): a dead engine stops heartbeating,
+        its disks expire, and check_disks turns them into disk-repair tasks.
+        Returns the disk ids newly marked broken."""
+        timeout = self.hb_timeout_s if timeout_s is None else timeout_s
+        if timeout <= 0:
+            return []
+        stale = self.cm.expire_heartbeats(timeout)
+        if stale:
+            registry("scheduler").counter("hb_expired_disks").add(len(stale))
+        return stale
 
     # -- blob deleter ---------------------------------------------------------
 
@@ -423,34 +626,91 @@ class RepairWorker:
     """Executes repair/migrate tasks with batched TPU reconstructs.
 
     Reference: blobnode's embedded worker (task_runner.go:171,
-    work_shard_recover.go:399-547). The TPU-native difference: one task's
+    work_shard_recover.go:399-547). The TPU-native differences: one task's
     stripes are stacked into large (B, n, k) reconstruct batches instead of
-    per-stripe loops.
+    per-stripe loops, and bulk migrates run a WINDOWED pipeline — up to
+    CFS_REPAIR_WINDOW stripes' survivor downloads in flight while earlier
+    stripes decode on the device (the PUT pipeline's window pattern applied
+    to repair-GET). Every task runs under a `scheduler.repair` span whose
+    `download` stages and the codec's `codec.host`/`codec.device` stages let
+    cfs-trace prove the overlap.
     """
 
     def __init__(self, sched: Scheduler, nodes: dict[int, BlobNode],
-                 codec: CodecService | None = None):
+                 codec: CodecService | None = None,
+                 read_deadline: float = 3.0,
+                 repair_window: int | None = None):
         self.sched = sched
         self.cm = sched.cm
         self.nodes = nodes
         self.codec = codec or sched.codec
+        # every survivor read races this deadline: a wedged blobnode turns
+        # into a typed probe_fail{timeout}, never a silent stall
+        self.read_deadline = read_deadline
+        if repair_window is None:
+            repair_window = int(os.environ.get("CFS_REPAIR_WINDOW", "4"))
+        self.repair_window = repair_window  # 0/1 = serial gather
+        # stripe-level window workers (one per in-flight gather) and the
+        # shard-read fan-out pool they share; both bounded so one repair
+        # task can't monopolize a host
+        self._stripe_pool = ThreadPoolExecutor(
+            max_workers=max(1, repair_window or 1),
+            thread_name_prefix="repair-stripe")
+        self._shard_pool = ThreadPoolExecutor(
+            max_workers=16, thread_name_prefix="repair-io")
+
+    def set_repair_window(self, window: int) -> None:
+        """Change the stripe window AND resize the pool that realizes it —
+        assigning repair_window bare would leave a pool sized for the old
+        window silently serializing (or over-parallelizing) the gathers."""
+        if window == self.repair_window:
+            return
+        self.repair_window = window
+        old = self._stripe_pool
+        self._stripe_pool = ThreadPoolExecutor(
+            max_workers=max(1, window or 1),
+            thread_name_prefix="repair-stripe")
+        old.shutdown(wait=False)
+
+    def close(self) -> None:
+        """Shut down the worker's executors (racelint: unjoined-thread).
+        wait=False mirrors Access.close — a read wedged on a dead node must
+        not stall teardown; it fails on its own deadline."""
+        self._stripe_pool.shutdown(wait=False)
+        self._shard_pool.shutdown(wait=False)
 
     def run_once(self) -> bool:
         """Process one task; failures are recorded on the task, never raised —
-        one poisoned stripe must not stall the background plane."""
+        one poisoned stripe must not stall the background plane. The whole
+        task executes under a root span so repair traces are analyzable, and
+        the report carries the ACQUIRE-time lease: if the lease expired and
+        the reaper re-queued the task mid-flight, this report is dropped as
+        stale (idempotent write-back makes the re-execution safe)."""
         task = self.sched.acquire_task()
         if task is None:
             return False
-        try:
-            if task.kind == KIND_SHARD_REPAIR:
-                self._repair_shards(task.vid, task.bid, task.bad_idx)
-            elif task.kind == KIND_BALANCE:
-                self._balance_unit(task)
-            elif task.kind in (KIND_DISK_REPAIR, KIND_DISK_DROP):
-                self._migrate_disk(task)
-            self.sched.report_task(task.task_id, True)
-        except Exception as e:
-            self.sched.report_task(task.task_id, False, error=f"{type(e).__name__}: {e}")
+        lease = task.lease  # capture NOW: the field advances on re-lease
+        reg = registry("scheduler")
+        with trace.child_of(trace.current_span(), "scheduler.repair") as span:
+            span.set_tag("task", task.task_id)
+            span.set_tag("kind", task.kind)
+            span.set_tag("window", self.repair_window)
+            ok, err = True, ""
+            try:
+                if task.kind == KIND_SHARD_REPAIR:
+                    self._repair_shards(task.vid, task.bid, task.bad_idx)
+                elif task.kind == KIND_BALANCE:
+                    self._balance_unit(task)
+                elif task.kind in (KIND_DISK_REPAIR, KIND_DISK_DROP):
+                    self._migrate_disk(task, lease)
+            except Exception as e:
+                ok, err = False, f"{type(e).__name__}: {e}"
+            ratio = stage_overlap_ratio(span.stages)
+            if ratio is not None:
+                span.set_tag("overlap_ratio", round(ratio, 3))
+                reg.summary("repair_overlap_ratio",
+                            buckets=RATIO_BUCKETS).observe(ratio)
+            self.sched.report_task(task.task_id, ok, error=err, lease=lease)
         return True
 
     # -- single-stripe shard repair -------------------------------------------
@@ -471,12 +731,13 @@ class RepairWorker:
         recoverByLocalStripe): for each AZ whose damage fits its local parity
         budget, repair reading ONLY that AZ's shards. Returns the reported bad
         indexes that still need the global path."""
+        span = trace.current_span()
         leftover: list[int] = []
         for idx, local_n, local_m in t.local_stripes():
             az_reported = [i for i in bad_idx if i in idx]
             if not az_reported:
                 continue
-            reads = self._probe(vol, bid, idx)  # same-AZ reads only
+            reads = self._probe(vol, bid, idx, span=span)  # same-AZ reads only
             az_bad = [i for i in idx if i not in reads]
             if not az_bad:
                 continue
@@ -493,22 +754,29 @@ class RepairWorker:
             ).result()
             for g in az_bad:
                 self._write_back(vol, g, bid, fixed[pos[g]].tobytes())
+            # the repair-traffic win the LRC layout buys: these shards were
+            # healed reading ONE local group, not the global stripe
+            registry("scheduler").counter(
+                "repair_local_shards").add(len(az_bad))
         return leftover
 
     def _repair_global(self, vol: VolumeInfo, t, bid: int):
         """Global-stripe repair + recompute of any missing local parities."""
-        stripe, present, shard_len = self._gather(vol, t, bid)
+        span = trace.current_span()
+        stripe, present, shard_len = self._gather(vol, t, bid, span=span)
         missing = [i for i in range(t.N + t.M) if i not in present]
         if missing:
             fixed = self.codec.reconstruct(t.N, t.M, stripe, missing).result()
             for idx in missing:
                 self._write_back(vol, idx, bid, fixed[idx].tobytes())
             stripe = fixed
+            registry("scheduler").counter(
+                "repair_global_shards").add(len(missing))
         if t.L:
             # local parities live outside the global stripe: any missing one is
             # recomputed from its AZ's (now whole) global shards
             local_idx = list(range(t.global_count, t.total))
-            have = self._probe(vol, bid, local_idx)
+            have = self._probe(vol, bid, local_idx, span=span)
             lost_azs = {t.az_of_shard(i) for i in local_idx if i not in have}
             local_n = (t.N + t.M) // t.az_count
             local_m = t.L // t.az_count
@@ -523,37 +791,70 @@ class RepairWorker:
                         self._write_back(vol, g, bid, full[local_n + p].tobytes())
 
     def _write_back(self, vol: VolumeInfo, idx: int, bid: int, payload: bytes):
+        """Idempotent by construction: put_shard over an existing bid punches
+        the superseded record and appends the same bytes, so a re-executed
+        task (lease expiry, crash-restart) can never corrupt the stripe."""
         unit = vol.units[idx]
         node = self.nodes[unit.node_id]
         node.create_vuid(unit.vuid, unit.disk_id)
         node.put_shard(unit.vuid, bid, payload)
+        registry("scheduler").counter("repaired_shards").add()
 
-    def _probe(self, vol: VolumeInfo, bid: int, idxs) -> dict[int, bytes]:
-        """Read the given stripe positions; absent/unreachable ones are omitted."""
-        reads: dict[int, bytes] = {}
-        for idx in idxs:
-            unit = vol.units[idx]
-            node = self.nodes.get(unit.node_id)
-            if node is None:
-                continue
+    def _read_one(self, vol: VolumeInfo, idx: int, bid: int) -> bytes:
+        unit = vol.units[idx]
+        node = self.nodes.get(unit.node_id)
+        if node is None:
+            raise ConnectionError(f"node {unit.node_id} unknown")
+        return node.get_shard(unit.vuid, bid)
+
+    def _drain_reads(self, futs: dict, out: dict) -> list:
+        """Drain a {key: Future-of-bytes} fan-out under ONE shared
+        read_deadline: successes land in `out` and feed the repair-traffic
+        byte accounting; absent/unreachable/hung reads are returned as
+        leftover keys, counted by failure class
+        (cfs_scheduler_probe_fail{reason}) so a silent hang and a real bug
+        stop being indistinguishable. The one timeout/cancel/classify
+        block both _probe and _copy_direct ride — their semantics must
+        never diverge."""
+        reg = registry("scheduler")
+        deadline = time.monotonic() + self.read_deadline
+        leftover = []
+        for key, f in futs.items():
             try:
-                reads[idx] = node.get_shard(unit.vuid, bid)
-            except Exception:
+                data = f.result(timeout=max(0.0, deadline - time.monotonic()))
+            except FutureTimeout:
+                f.cancel()  # queued laggards release their pool slot
+                reg.counter("probe_fail", {"reason": "timeout"}).add()
+                leftover.append(key)
                 continue
+            except Exception as e:
+                reg.counter("probe_fail",
+                            {"reason": classify_io_error(e)}).add()
+                leftover.append(key)
+                continue
+            out[key] = data
+            reg.counter("repair_bytes_downloaded").add(len(data))
+        return leftover
+
+    def _probe(self, vol: VolumeInfo, bid: int, idxs,
+               span=None) -> dict[int, bytes]:
+        """Read the given stripe positions CONCURRENTLY via _drain_reads;
+        the whole fan-out lands on the span as a `download` stage."""
+        idxs = list(idxs)
+        if not idxs:
+            return {}
+        t0 = time.perf_counter()
+        futs = {i: self._shard_pool.submit(self._read_one, vol, i, bid)
+                for i in idxs}
+        reads: dict[int, bytes] = {}
+        self._drain_reads(futs, reads)
+        if span is not None:
+            span.add_stage("download", start=t0)
         return reads
 
-    def _gather(self, vol: VolumeInfo, t, bid: int):
+    def _gather(self, vol: VolumeInfo, t, bid: int, span=None):
         """Read every readable global shard of a stripe; infer shard_len."""
-        reads: dict[int, bytes] = {}
-        for idx in range(t.N + t.M):
-            unit = vol.units[idx]
-            node = self.nodes.get(unit.node_id)
-            if node is None:
-                continue
-            try:
-                reads[idx] = node.get_shard(unit.vuid, bid)
-            except Exception:
-                continue
+        reads = self._probe(vol, bid, range(t.N + t.M), span=span)
         if len(reads) < t.N:
             raise RuntimeError(f"stripe {vol.vid}/{bid}: {len(reads)} < N={t.N} readable")
         shard_len = len(next(iter(reads.values())))
@@ -564,17 +865,41 @@ class RepairWorker:
 
     # -- disk-level migrate (bulk; the 10k-stripe batch path) ------------------
 
-    def _migrate_disk(self, task: Task):
+    def _migrate_disk(self, task: Task, lease: int | None = None):
         """Move every stripe position off a disk.
 
         Order matters: GATHER (and copy/reconstruct) the rows through the OLD
-        unit first — for a drop of a healthy disk that's a plain read-copy —
-        and only then re-home the unit in clustermgr. A crash mid-volume leaves
-        the old mapping intact and the task retryable."""
+        units first — for a drop of a healthy disk that's a plain read-copy —
+        and only then re-home the units in clustermgr. A crash mid-task
+        leaves every uncommitted unit's old mapping intact and the task
+        retryable. The prepare/commit split is also the cross-unit pipeline:
+        while unit k's reconstructs drain through the device, unit k+1's
+        survivor downloads are already in flight — with few bids per unit,
+        this (not the intra-unit window) is where the overlap comes from."""
         source_broken = self.cm.disks[task.disk_id].status != DISK_NORMAL
         affected = self.cm.volumes_on_disk(task.disk_id)
+        # bounded prepare-ahead: holding every unit's reconstructed rows at
+        # once would scale memory with the whole disk, not the window.
+        # window <= 1 means the SERIAL control path — depth 1, no cross-unit
+        # overlap either, so the bench A/B measures what it claims to
+        window = self.repair_window or 0
+        depth = max(2, window) if window > 1 else 1
+        pending: deque = deque()
         for vol, unit in affected:
-            self._migrate_unit(vol, unit, task.disk_id, source_broken)
+            # a disk migrate routinely outlives one lease: renew per unit so
+            # a HEALTHY worker never races the reaper; a lost lease (we were
+            # reaped and possibly re-leased) aborts — the work is someone
+            # else's now, and idempotent write-back keeps the abort safe
+            if lease is not None and \
+                    not self.sched.renew_lease(task.task_id, lease):
+                raise RuntimeError(
+                    f"lease {lease} lost mid-migrate of disk {task.disk_id}")
+            pending.append(
+                self._prepare_unit(vol, unit, task.disk_id, source_broken))
+            if len(pending) >= depth:
+                self._commit_unit(pending.popleft(), task.disk_id)
+        while pending:
+            self._commit_unit(pending.popleft(), task.disk_id)
         self.cm.set_disk_status(task.disk_id, DISK_DROPPED)
 
     def _balance_unit(self, task: Task):
@@ -589,8 +914,9 @@ class RepairWorker:
             self._enqueue_missing(vol)
             return
         source_broken = self.cm.disks[task.disk_id].status != DISK_NORMAL
-        self._migrate_unit(vol, unit, task.disk_id, source_broken,
-                           dest_disk_id=task.dest_disk_id)
+        prep = self._prepare_unit(vol, unit, task.disk_id, source_broken)
+        self._commit_unit(prep, task.disk_id,
+                          dest_disk_id=task.dest_disk_id)
 
     def _enqueue_missing(self, vol: VolumeInfo):
         """Probe every stripe position of every bid in the volume; feed any
@@ -612,11 +938,99 @@ class RepairWorker:
                 self.sched.proxy.send_shard_repair(vol.vid, bid, bad,
                                                    "balance_retry")
 
-    def _migrate_unit(self, vol: VolumeInfo, unit, source_disk_id: int,
-                      source_broken: bool, dest_disk_id: int | None = None):
-        """Re-home one stripe position: copy (healthy source) or reconstruct
-        the rows, then update the clustermgr mapping and write to the new
-        disk. Shared by disk-level migrate and the balancer."""
+    def _copy_direct(self, vol: VolumeInfo, unit, bids: list[int],
+                     rows: dict[int, bytes]) -> list[int]:
+        """Healthy-source fast path: CONCURRENT bounded reads of the unit's
+        own rows via _drain_reads (a serial loop here would pay
+        read_deadline per slow bid, not per unit). Returns the bids that
+        still need the gather/reconstruct pipeline."""
+        node = self.nodes.get(unit.node_id)
+        if node is None:
+            return list(bids)
+        futs = {bid: self._shard_pool.submit(node.get_shard, unit.vuid, bid)
+                for bid in bids}
+        return self._drain_reads(futs, rows)
+
+    def _stripe_row(self, vol: VolumeInfo, t, unit, bid: int, gathered,
+                    rows: dict[int, bytes], futures: dict[int, object]):
+        """Turn one gathered stripe into the migrating unit's row: a present
+        survivor copies, a lost global shard becomes a (batchable) device
+        reconstruct future, a lost local parity re-encodes its AZ stripe."""
+        stripe, present, _ = gathered
+        missing = [i for i in range(t.N + t.M) if i not in present]
+        if unit.index in present:
+            rows[bid] = stripe[unit.index].tobytes()
+        elif unit.index < t.global_count:
+            # repair with the FULL missing set: zero-filled absent rows
+            # must never be treated as survivors
+            futures[bid] = self.codec.reconstruct(t.N, t.M, stripe, missing)
+        else:
+            # LRC local parity: complete the globals, then re-encode
+            # this AZ's local stripe to regenerate the lost row
+            if missing:
+                stripe = self.codec.reconstruct(t.N, t.M, stripe, missing).result()
+            local_n = (t.N + t.M) // t.az_count
+            local_m = t.L // t.az_count
+            for idx, _, _ in t.local_stripes():
+                if unit.index in idx:
+                    full = self.codec.encode(
+                        local_n, local_m, stripe[idx[:local_n]]
+                    ).result()
+                    pos = idx[local_n:].index(unit.index)
+                    rows[bid] = full[local_n + pos].tobytes()
+                    break
+
+    def _rebuild_rows(self, vol: VolumeInfo, t, unit, bids: list[int],
+                      rows: dict[int, bytes], futures: dict[int, object]):
+        """The windowed rebuild pipeline (the _put_pipelined window pattern
+        applied to repair-GET): up to repair_window stripes' survivor
+        gathers run on the stripe pool while earlier stripes' reconstructs
+        drain through the codec service's device batches — downloads never
+        idle waiting on decode, decode never starves waiting on the network.
+        Consumption is bid order, so write-back order is deterministic.
+        repair_window <= 1 degenerates to the serial control path."""
+        if not bids:
+            return
+        span = trace.current_span()
+        window = self.repair_window
+        if window <= 1:
+            for bid in bids:
+                self._stripe_row(vol, t, unit, bid,
+                                 self._gather(vol, t, bid, span=span),
+                                 rows, futures)
+            return
+
+        def gather_job(bid: int):
+            # the task span follows the gather onto the pool worker so its
+            # download stage (and any failpoint evidence) lands on the trace
+            if span is not None:
+                trace.push_span(span)
+            try:
+                return self._gather(vol, t, bid, span=span)
+            finally:
+                if span is not None:
+                    trace.pop_span()
+
+        occ = registry("scheduler").summary("rebuild_window_occupancy",
+                                            buckets=BATCH_BUCKETS)
+        pending: deque = deque()
+        it = iter(bids)
+        nxt = next(it, None)
+        while pending or nxt is not None:
+            while nxt is not None and len(pending) < window:
+                pending.append((nxt, self._stripe_pool.submit(gather_job, nxt)))
+                nxt = next(it, None)
+            occ.observe(len(pending))
+            bid, f = pending.popleft()
+            self._stripe_row(vol, t, unit, bid, f.result(), rows, futures)
+
+    def _prepare_unit(self, vol: VolumeInfo, unit, source_disk_id: int,
+                      source_broken: bool) -> dict:
+        """Phase 1 of a unit move: gather/copy every row and SUBMIT the
+        reconstructs (decode futures left in flight — the codec service
+        batches them into shared device calls, and the caller may start the
+        next unit's downloads while they drain). No cluster state changes
+        here: a crash after prepare leaves the old mapping untouched."""
         t = vol.tactic()
         # every bid in this volume, seen from any unit (source included when healthy)
         bids: set[int] = set()
@@ -630,12 +1044,11 @@ class RepairWorker:
                 bids.update(m.bid for m in node.list_shards(u.vuid))
             except Exception:
                 continue
-        # phase 1: source copies or reconstruct futures (submitted together so
-        # the codec service batches them into shared device calls). Tombstones
-        # TRAVEL with the unit — enumerated DIRECTLY from the source chunk
-        # (they are invisible to list_shards, so deriving them from live bids
-        # would drop any delete whose bid no reachable unit still serves) —
-        # a bid deleted at the source must stay deleted at the destination.
+        # source copies or reconstruct futures. Tombstones TRAVEL with the
+        # unit — enumerated DIRECTLY from the source chunk (they are
+        # invisible to list_shards, so deriving them from live bids would
+        # drop any delete whose bid no reachable unit still serves) — a bid
+        # deleted at the source must stay deleted at the destination.
         src_node = self.nodes.get(unit.node_id)
         tombstoned: set[int] = set()
         if src_node is not None:
@@ -645,40 +1058,21 @@ class RepairWorker:
                 pass
         rows: dict[int, bytes] = {}
         futures: dict[int, object] = {}
-        for bid in sorted(bids):
-            if bid in tombstoned:
-                continue
-            if not source_broken:
-                try:
-                    node = self.nodes[unit.node_id]
-                    rows[bid] = node.get_shard(unit.vuid, bid)
-                    continue
-                except Exception:
-                    pass  # fall through to reconstruct
-            stripe, present, _ = self._gather(vol, t, bid)
-            missing = [i for i in range(t.N + t.M) if i not in present]
-            if unit.index in present:
-                rows[bid] = stripe[unit.index].tobytes()
-            elif unit.index < t.global_count:
-                # repair with the FULL missing set: zero-filled absent rows
-                # must never be treated as survivors
-                futures[bid] = self.codec.reconstruct(t.N, t.M, stripe, missing)
-            else:
-                # LRC local parity: complete the globals, then re-encode
-                # this AZ's local stripe to regenerate the lost row
-                if missing:
-                    stripe = self.codec.reconstruct(t.N, t.M, stripe, missing).result()
-                local_n = (t.N + t.M) // t.az_count
-                local_m = t.L // t.az_count
-                for idx, _, _ in t.local_stripes():
-                    if unit.index in idx:
-                        full = self.codec.encode(
-                            local_n, local_m, stripe[idx[:local_n]]
-                        ).result()
-                        pos = idx[local_n:].index(unit.index)
-                        rows[bid] = full[local_n + pos].tobytes()
-                        break
-        for bid, fut in futures.items():
+        work = [b for b in sorted(bids) if b not in tombstoned]
+        if not source_broken:
+            work = self._copy_direct(vol, unit, work, rows)
+        self._rebuild_rows(vol, t, unit, work, rows, futures)
+        return {"vol": vol, "unit": unit, "rows": rows, "futures": futures,
+                "tombstoned": tombstoned}
+
+    def _commit_unit(self, prep: dict, source_disk_id: int,
+                     dest_disk_id: int | None = None):
+        """Phase 2: resolve the in-flight decodes, then re-home the unit in
+        clustermgr and write everything to the new disk. The mapping update
+        stays AFTER all reads/decodes so a failed prepare never half-moves."""
+        vol, unit = prep["vol"], prep["unit"]
+        rows, tombstoned = prep["rows"], prep["tombstoned"]
+        for bid, fut in prep["futures"].items():
             rows[bid] = fut.result()[unit.index].tobytes()
 
         dest = dest_disk_id
@@ -696,6 +1090,7 @@ class RepairWorker:
         dest_node.create_vuid(new_unit.vuid, new_unit.disk_id)
         for bid, payload in rows.items():
             dest_node.put_shard(new_unit.vuid, bid, payload)
+        registry("scheduler").counter("repaired_shards").add(len(rows))
         for bid in tombstoned:
             dest_node.tombstone_shard(new_unit.vuid, bid)
         # the move must FREE the source: drop the superseded chunk (best

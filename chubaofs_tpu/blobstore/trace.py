@@ -53,6 +53,49 @@ def set_finish_hook(fn) -> None:
     global _finish_hook
     _finish_hook = fn
 
+
+def finish_hook():
+    """The currently installed span-finish hook (None if none) — a caller
+    that temporarily swaps its own hook in must save this and CHAIN to it,
+    or an active trace sink silently loses every span it swallows."""
+    return _finish_hook
+
+
+def union_len(intervals) -> float:
+    """Total length of the union of [s, e) intervals (overlap counts once).
+    THE sweep-line both overlap consumers share — the scheduler's
+    repair-span overlap ratio and cfs-trace's critical-path/stage-overlap
+    analyzers must agree on this math or their reported ratios drift."""
+    total = 0.0
+    end = float("-inf")
+    for s, e in sorted(intervals):
+        if e <= end:
+            continue
+        total += e - max(s, end)
+        end = e
+    return total
+
+
+def intersect_len(a, b) -> float:
+    """Length of the intersection of two interval unions (inclusion-
+    exclusion over union_len): how long BOTH families were active at once."""
+    if not a or not b:
+        return 0.0
+    return union_len(a) + union_len(b) - union_len(list(a) + list(b))
+
+
+def overlap_ratio(a, b) -> float | None:
+    """Intersection of two interval-union families over the SMALLER union —
+    1.0 means the lesser family ran entirely inside the greater (perfect
+    pipelining), 0.0 means strictly back-to-back, None means either side
+    never happened. THE ratio definition shared by the scheduler's
+    repair-span metric and cfs-trace's --overlap report: one implementation
+    so the dashboard number and the CLI report can never drift apart."""
+    if not a or not b:
+        return None
+    floor = min(union_len(a), union_len(b))
+    return (intersect_len(a, b) / floor) if floor > 0 else 0.0
+
 _local = threading.local()
 
 _SANITIZE = str.maketrans({";": "_", ":": "_", "\n": "_", "\r": "_"})

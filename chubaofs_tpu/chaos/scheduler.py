@@ -17,6 +17,11 @@ Fault kinds (all lift automatically after `duration` virtual steps):
                  nothing to lift — the scrub/repair plane must heal it)
   crash_restart  the node's in-process engine is closed and rebuilt from
                  its disks at lift time (process crash + restart)
+  node_kill      the node's engine is closed and REMOVED from the routing
+                 dict, permanently (a dead host). Nothing lifts: its
+                 heartbeats stop, the clustermgr expiry must mark its disks
+                 broken, and the repair plane must rebuild every affected
+                 stripe onto the survivors
 
 node_wedge/slow_disk/link_drop arm the ACCESS-layer call sites
 (`access.read_shard` / `access.write_shard`), not the blobnode ones: the
@@ -62,6 +67,7 @@ def builtin_plan(name: str, steps: int = 6) -> FaultPlan:
                          Fault("shard_bitrot", at=mid + 1),
                          Fault("shard_bitrot", at=mid + 2)],
         "crash_restart": [Fault("crash_restart", at=mid, duration=dur)],
+        "node_kill": [Fault("node_kill", at=mid)],
     }
     if name not in plans:
         raise ValueError(f"unknown plan {name!r}; have {sorted(plans)}")
@@ -145,6 +151,10 @@ class ChaosScheduler:
             fp.arm("raft.send", "drop", node=node, prob=p)
         elif kind == "crash_restart":
             self._crash(node)
+        elif kind == "node_kill":
+            self._kill(node)
+            self._log("inject", fault, node=node)
+            return  # permanent: nothing to lift, never enters _active
         else:
             raise ValueError(f"unknown fault kind {kind!r}")
         self._log("inject", fault, node=node)
@@ -200,6 +210,18 @@ class ChaosScheduler:
         # a crashed process answers nothing: error (not hang) like a RST
         fp.arm("access.read_shard", "error(crashed)", node=node)
         fp.arm("access.write_shard", "error(crashed)", node=node)
+
+    def _kill(self, node: int) -> None:
+        """Permanent kill: close the engine and REMOVE it from the routing
+        dict. No failpoints needed — reads see an unknown node, writes fail
+        and punish, and the stopped heartbeats are exactly the detection
+        signal the repair plane has to catch."""
+        eng = self.cluster.nodes.pop(node, None)
+        if eng is not None:
+            try:
+                eng.close()
+            except Exception:
+                pass
 
     def _restart(self, node: int) -> None:
         from chubaofs_tpu.blobstore.blobnode import BlobNode

@@ -22,7 +22,12 @@ import random
 import time
 
 from chubaofs_tpu.chaos import failpoints as fp
-from chubaofs_tpu.chaos.scheduler import ChaosScheduler, FaultPlan, builtin_plan
+from chubaofs_tpu.chaos.scheduler import (
+    ChaosScheduler,
+    Fault,
+    FaultPlan,
+    builtin_plan,
+)
 
 SIZES = [8_000, 120_000, 700_000, 2_000_000]
 
@@ -143,4 +148,234 @@ def run_soak(root: str, plan: FaultPlan | str, seed: int, rounds: int = 6,
     finally:
         sched.close()
         fp.reset()  # never leak armings into the next soak/test
+        c.close()
+
+
+def run_kill_soak(root: str, seed: int, n_nodes: int = 9,
+                  disks_per_node: int = 2, warm_puts: int = 10,
+                  live_puts: int = 8, hb_timeout: float = 0.75,
+                  wire_ms: float = 2.0, read_deadline: float = 0.5,
+                  write_deadline: float = 4.0, max_wait_s: float = 120.0,
+                  sizes: list[int] | None = None) -> dict:
+    """Kill a blobnode under live PUT load; the repair plane must notice and
+    rebuild (the ISSUE-7 acceptance scenario).
+
+    Phases: warm PUTs land acked blobs -> a seeded node_kill closes one
+    engine and removes it from routing (its heartbeats stop) -> the
+    clustermgr heartbeat expiry must mark the dead node's disks broken, the
+    scheduler must turn them into disk-repair tasks, and the windowed
+    rebuild pipeline must re-home every affected stripe onto the survivors
+    — all while fresh PUTs keep arriving. During the rebuild a
+    deterministic `wire_ms` delay rides every shard read (the deployment's
+    gateway->blobnode RTT, as in perfbench's _wire regime) so the
+    download/decode overlap the pipeline exists for is measurable; the
+    repair spans are captured and analyzed with the cfs-trace library.
+
+    Fails (SoakFailure) on: detection/rebuild timeout, any acked blob not
+    byte-identical after rebuild, zero rebuild throughput, or a stranded
+    WORKING task at soak end. Returns rebuild throughput, repair-traffic
+    accounting (bytes per repaired shard), the download/decode overlap
+    ratio, and the seeded event log."""
+    import numpy as np
+
+    from chubaofs_tpu.blobstore import trace
+    from chubaofs_tpu.blobstore.access import Access, AccessError
+    from chubaofs_tpu.blobstore.cluster import MiniCluster
+    from chubaofs_tpu.blobstore.clustermgr import DISK_NORMAL
+    from chubaofs_tpu.blobstore.proxy import TOPIC_SHARD_REPAIR
+    from chubaofs_tpu.blobstore.scheduler import TASK_PREPARED, TASK_WORKING
+    from chubaofs_tpu.blobstore.taskswitch import SWITCH_VOL_INSPECT
+    from chubaofs_tpu.tools.cfstrace import critical_path, stage_overlap
+    from chubaofs_tpu.utils.exporter import registry
+
+    sizes = sizes or SIZES
+    rnd = random.Random(seed)
+    rng = np.random.default_rng(seed)
+    c = MiniCluster(root, n_nodes=n_nodes, disks_per_node=disks_per_node)
+    c.access.close()
+    c.access = Access(c.cm, c.proxy, c.nodes, codec=c.codec, max_workers=64,
+                      read_deadline=read_deadline,
+                      write_deadline=write_deadline)
+    c.scheduler.hb_timeout_s = hb_timeout
+    # capture every repair span for the cfs-trace overlap proof (restore
+    # whatever hook — trace sink or none — was installed before us)
+    records: list[dict] = []
+    prev_hook = trace.finish_hook()
+
+    def _collect(span):
+        if span.operation == "scheduler.repair":
+            records.append(span.to_record())
+        if prev_hook is not None:
+            # chain: an installed trace sink must keep seeing EVERY span
+            # finished during the soak, not lose them to our capture
+            prev_hook(span)
+
+    trace.set_finish_hook(_collect)
+    reg = registry("scheduler")
+    shards0 = reg.counter("repaired_shards").value
+    bytes0 = reg.counter("repair_bytes_downloaded").value
+    live: dict[int, tuple] = {}
+    next_id = 0
+    stats = {"puts": 0, "puts_rejected": 0, "live_puts": 0}
+
+    def put_one(data: bytes) -> bool:
+        nonlocal next_id
+        try:
+            live[next_id] = (c.access.put(data), data)
+            next_id += 1
+            stats["puts"] += 1
+            return True
+        except AccessError:
+            stats["puts_rejected"] += 1
+            return False
+
+    try:
+        for _ in range(warm_puts):
+            data = rng.integers(0, 256, rnd.choice(sizes),
+                                dtype=np.uint8).tobytes()
+            while not put_one(data):
+                pass  # pre-kill: a healthy cluster must ack every PUT
+        # settle heartbeats once so no disk is stale at kill time
+        c.run_background_once()
+
+        plan = FaultPlan("node_kill", [Fault("node_kill", at=0)])
+        sched = ChaosScheduler(c, plan, seed=seed + 1)
+        sched.step()  # the seeded kill; the victim choice is in the log
+        killed = sched.events[-1]["node"]
+        victim_disks = [d.disk_id for d in c.cm.disks.values()
+                        if d.node_id == killed]
+
+        # rebuild under the deployment's latency shape: every shard read
+        # pays wire_ms, so download width is real and overlap measurable.
+        # The inspector sweep is paused for the rebuild window (it reads
+        # every shard of every volume per tick — detection here is
+        # heartbeat-driven, not inspector-driven) and re-enabled for the
+        # convergence proof below.
+        c.scheduler.switches.set(SWITCH_VOL_INSPECT, False)
+        if wire_ms > 0:
+            fp.arm("blobnode.get_shard", f"delay({wire_ms / 1000.0})")
+        t_kill = time.monotonic()
+        t_detect = None
+        rebuild_busy = 0.0  # wall time the worker actually spent rebuilding
+        pending_live = [
+            rng.integers(0, 256, rnd.choice(sizes), dtype=np.uint8).tobytes()
+            for _ in range(live_puts)]
+        try:
+            while True:
+                if time.monotonic() - t_kill > max_wait_s:
+                    raise SoakFailure(
+                        f"kill soak seed {seed}: rebuild did not finish in "
+                        f"{max_wait_s:.0f}s (victim node {killed})")
+                if pending_live:  # live PUT load rides the rebuild
+                    if put_one(pending_live[0]):
+                        stats["live_puts"] += 1
+                        pending_live.pop(0)
+                # the detection->repair chain, stepped discretely so the
+                # worker drain's wall time is measurable on its own (the
+                # rebuild-throughput denominator)
+                for n in list(c.nodes.values()):
+                    try:
+                        n.heartbeat(c.cm)
+                    except Exception:
+                        pass
+                c.scheduler.check_node_health()
+                c.scheduler.reap_expired()
+                c.scheduler.poll_repair_topic()
+                c.scheduler.check_disks()
+                statuses = {c.cm.disks[d].status for d in victim_disks}
+                if t_detect is None and statuses != {DISK_NORMAL}:
+                    t_detect = time.monotonic()
+                t0w = time.monotonic()
+                ran = 0
+                while c.worker.run_once():
+                    ran += 1
+                if ran:
+                    rebuild_busy += time.monotonic() - t0w
+                open_tasks = (c.scheduler.tasks(state=TASK_PREPARED)
+                              + c.scheduler.tasks(state=TASK_WORKING))
+                if (t_detect is not None and DISK_NORMAL not in statuses
+                        and not open_tasks
+                        and c.proxy.topics[TOPIC_SHARD_REPAIR].lag(
+                            "scheduler") == 0):
+                    break
+                time.sleep(0.05)  # let the heartbeat-silence clock advance
+        finally:
+            if wire_ms > 0:
+                fp.disarm("blobnode.get_shard")
+            c.scheduler.switches.set(SWITCH_VOL_INSPECT, True)
+        t_done = time.monotonic()
+
+        # recovery is confirmed (rebuild finished): drop the punish windows
+        # the dead node earned so post-rebuild PUTs trust the healed layout
+        c.access.clear_punishments()
+        # land any live PUTs the quorum rejected mid-rebuild
+        for data in pending_live:
+            for _ in range(50):
+                if put_one(data):
+                    break
+                c.run_background_once()
+            else:
+                raise SoakFailure(f"kill soak seed {seed}: PUT still "
+                                  f"rejected after the rebuild converged")
+
+        # converge: repair planes drain and a FULL inspector sweep is quiet
+        converged = False
+        for _ in range(16):
+            c.run_background_once()
+            if c.scheduler.inspect_volumes(max_volumes=1000) == 0:
+                converged = True
+                break
+        if not converged:
+            raise SoakFailure(f"kill soak seed {seed}: inspector never went "
+                              f"quiet after the rebuild")
+
+        # THE invariants: every acked blob byte-identical on the survivors,
+        # no unit still mapped to a dead disk, zero stranded WORKING tasks
+        for idx, (loc, data) in live.items():
+            if c.access.get(loc) != data:
+                raise SoakFailure(
+                    f"kill soak seed {seed}: blob {idx} miscompares after "
+                    f"rebuild of node {killed}")
+        for vol in c.cm.volumes.values():
+            for u in vol.units:
+                if u.disk_id in victim_disks:
+                    raise SoakFailure(
+                        f"kill soak seed {seed}: unit {u.vuid} still on dead "
+                        f"disk {u.disk_id}")
+        stranded = c.scheduler.tasks(state=TASK_WORKING)
+        if stranded:
+            raise SoakFailure(
+                f"kill soak seed {seed}: {len(stranded)} WORKING tasks "
+                f"stranded at soak end")
+
+        rebuilt = reg.counter("repaired_shards").value - shards0
+        dl_bytes = reg.counter("repair_bytes_downloaded").value - bytes0
+        rebuild_s = max(1e-9, rebuild_busy)
+        if rebuilt <= 0:
+            raise SoakFailure(
+                f"kill soak seed {seed}: zero rebuild throughput "
+                f"(no shards repaired after killing node {killed})")
+        # the cfs-trace proof: per-repair-trace download/decode overlap
+        overlap, best_report = 0.0, None
+        for rec in records:
+            ov = stage_overlap([rec], "download", "codec.")
+            if ov["ratio"] > overlap or best_report is None:
+                overlap = max(overlap, ov["ratio"])
+                best_report = critical_path([rec])
+        return {
+            "plan": "kill_blobnode", "seed": seed, "ok": True,
+            "events": list(sched.events), "killed_node": killed,
+            "detect_s": round((t_detect or t_done) - t_kill, 3),
+            "rebuild_s": round(rebuild_s, 3),
+            "rebuilt_shards": int(rebuilt),
+            "rebuild_shards_per_s": round(rebuilt / rebuild_s, 1),
+            "bytes_per_repaired_shard": round(dl_bytes / rebuilt, 1),
+            "repair_overlap_ratio": round(overlap, 3),
+            "repair_traces": len(records),
+            "critical_path": best_report,
+            **stats,
+        }
+    finally:
+        trace.set_finish_hook(prev_hook)
+        fp.reset()
         c.close()

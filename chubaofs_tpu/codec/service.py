@@ -301,7 +301,8 @@ class CodecService:
         with self._stats_lock:
             return dict(self.stats)
 
-    def _record_batch(self, jobs: int, elapsed_s: float) -> None:
+    def _record_batch(self, jobs: int, elapsed_s: float,
+                      kind: str = "") -> None:
         with self._stats_lock:
             self.stats["batches"] += 1
             self.stats["jobs"] += jobs
@@ -311,6 +312,11 @@ class CodecService:
         reg = registry("codec")
         reg.counter("batches_total").add()
         reg.counter("jobs_total").add(jobs)
+        if kind:
+            # the encode/matmul split: proves repair DECODE really batches
+            # on the device (bench_repair and the kill soak read this)
+            reg.counter("kind_jobs_total", {"kind": kind}).add(jobs)
+            reg.counter("kind_batches_total", {"kind": kind}).add()
         reg.summary("batch_jobs", buckets=BATCH_BUCKETS).observe(jobs)
         reg.summary("dispatch_seconds").observe(elapsed_s)
 
@@ -335,7 +341,7 @@ class CodecService:
 
             out = mm(bitmatrix.expand_matrix(jobs[0].mat).astype(np.int8), stack)
         t_done = _time.perf_counter()
-        self._record_batch(len(jobs), t_done - t0)
+        self._record_batch(len(jobs), t_done - t0, kind=str(sig[0]))
         for j in jobs:
             if j.span is not None:
                 # the BATCH's wall intervals, attributed to every rider: the

@@ -66,6 +66,17 @@ def diff_metrics(before: dict[str, float], after: dict[str, float],
     return rows
 
 
+REPAIR_METRIC_MARKS = ("cfs_scheduler_", "scrub", "repair")
+
+
+def is_repair_metric(name: str) -> bool:
+    """The repair-plane rollup filter (--repair): scheduler task gauges by
+    kind/state, lease expiries, stale reports, probe failures, scrub
+    progress, and the bytes-downloaded / shards-repaired counters the
+    bytes-per-repaired-shard claim is computed from."""
+    return any(mark in name for mark in REPAIR_METRIC_MARKS)
+
+
 def scrape(addr: str, path: str = "/metrics", timeout: float = 10.0) -> str:
     from chubaofs_tpu.rpc.pool import NullPool
 
@@ -96,6 +107,11 @@ def main(argv=None, out=None) -> int:
                    help="seconds between the two snapshots")
     p.add_argument("--filter", default="",
                    help="only metrics whose name contains this substring")
+    p.add_argument("--repair", action="store_true",
+                   help="repair-plane rollup: only scheduler/scrub/repair "
+                        "metrics (task counts by kind/state, lease "
+                        "expiries, probe failures, scrub progress, repair "
+                        "traffic), statics included")
     p.add_argument("--all", action="store_true",
                    help="include zero-delta metrics")
     p.add_argument("--slowops", action="store_true",
@@ -135,7 +151,11 @@ def main(argv=None, out=None) -> int:
     rows = diff_metrics(before, after, elapsed)
     if args.filter:
         rows = [r for r in rows if args.filter in r["metric"]]
-    if not args.all:
+    if args.repair:
+        # a repair inventory is mostly GAUGES sitting still (tasks by
+        # kind/state): statics are the point, so --repair implies --all
+        rows = [r for r in rows if is_repair_metric(r["metric"])]
+    elif not args.all:
         rows = [r for r in rows if r["delta"] != 0]
     if args.json:
         blob = {"interval_s": round(elapsed, 3), "rows": rows}

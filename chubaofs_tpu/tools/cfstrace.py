@@ -29,6 +29,13 @@ import json
 import os
 import sys
 
+# the one sweep-line interval union + overlap-ratio math both overlap
+# consumers share (the scheduler's repair overlap ratio rides the same
+# functions, so the dashboard metric and this CLI can never drift)
+from chubaofs_tpu.blobstore.trace import intersect_len as _intersect
+from chubaofs_tpu.blobstore.trace import overlap_ratio as _overlap_ratio
+from chubaofs_tpu.blobstore.trace import union_len as _union
+
 BAR_WIDTH = 40
 
 
@@ -54,18 +61,6 @@ def build_tree(records: list[dict]) -> tuple[list[dict], dict[str, list[dict]]]:
 def _span_interval(rec: dict) -> tuple[float, float]:
     s = float(rec.get("start", 0.0))
     return s, s + rec.get("dur_us", 0) / 1e6
-
-
-def _union(intervals: list[tuple[float, float]]) -> float:
-    """Total length of the union of [s, e) intervals."""
-    total = 0.0
-    end = float("-inf")
-    for s, e in sorted(intervals):
-        if e <= end:
-            continue
-        total += e - max(s, end)
-        end = e
-    return total
 
 
 def _pick_root(records: list[dict], root_op: str | None) -> dict | None:
@@ -148,6 +143,35 @@ def critical_path(records: list[dict], root_op: str | None = None) -> dict:
         "coverage": round(covered / wall, 4) if wall > 0 else 0.0,
         "spans": len(records),
         "stages": stages,
+    }
+
+
+def stage_overlap(records: list[dict], a: str, b: str) -> dict:
+    """How much two stage families of a trace ran CONCURRENTLY: collect the
+    intervals of every stage whose name matches `a` (exact or prefix — pass
+    "codec." to cover codec.host+codec.device) and likewise `b`, then
+    measure the intersection of the two interval unions. `ratio` is that
+    intersection over the SMALLER union — 1.0 means the lesser stage was
+    entirely hidden behind the greater (perfect pipelining), 0.0 means they
+    ran back-to-back. The repair plane's download/decode overlap proof."""
+
+    def intervals(prefix: str) -> list[tuple[float, float]]:
+        out = []
+        for rec in records:
+            base = float(rec.get("start", 0.0))
+            for name, off_us, dur_us in rec.get("stages", ()):
+                if name == prefix or str(name).startswith(prefix):
+                    s = base + off_us / 1e6
+                    out.append((s, s + dur_us / 1e6))
+        return out
+
+    ia, ib = intervals(a), intervals(b)
+    ratio = _overlap_ratio(ia, ib)
+    return {
+        "a": a, "b": b,
+        "a_ms": round(_union(ia) * 1e3, 3), "b_ms": round(_union(ib) * 1e3, 3),
+        "overlap_ms": round(_intersect(ia, ib) * 1e3, 3),
+        "ratio": 0.0 if ratio is None else round(ratio, 4),
     }
 
 
@@ -414,6 +438,11 @@ def main(argv=None, out=None) -> int:
                    help="skip the critical-path report")
     p.add_argument("--root-op", default=None,
                    help="analyze this op's span as the critical-path root")
+    p.add_argument("--overlap", default=None, metavar="A,B",
+                   help="also report how much stage families A and B ran "
+                        "concurrently (prefix match; e.g. "
+                        "'download,codec.' proves repair download/decode "
+                        "overlap)")
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
 
@@ -443,14 +472,25 @@ def main(argv=None, out=None) -> int:
         print(f"no spans for trace {args.trace_id}", file=sys.stderr)
         return 1
     rep = critical_path(records, root_op=args.root_op)
+    overlap = None
+    if args.overlap:
+        a, _, b = args.overlap.partition(",")
+        overlap = stage_overlap(records, a.strip(), b.strip())
     if args.json:
-        print(json.dumps({"spans": records, "report": rep}, indent=2),
-              file=out)
+        blob = {"spans": records, "report": rep}
+        if overlap is not None:
+            blob["overlap"] = overlap
+        print(json.dumps(blob, indent=2), file=out)
         return 0
     print(flamegraph(records) if args.flame else waterfall(records), file=out)
     if not args.no_report:
         print("", file=out)
         print(render_report(rep), file=out)
+    if overlap is not None:
+        print(f"overlap {overlap['a']} ∩ {overlap['b']}: "
+              f"{overlap['overlap_ms']}ms of "
+              f"min({overlap['a_ms']}, {overlap['b_ms']})ms "
+              f"(ratio {overlap['ratio']})", file=out)
     return 0
 
 

@@ -9,6 +9,7 @@ debuggable by replaying its seed.
 
     cfs-chaos-soak --seed 7                  # the 3 acceptance plans
     cfs-chaos-soak --plan link_drop --rounds 8 --verify-repro
+    cfs-chaos-soak --kill-blobnode --seed 7  # kill-a-blobnode rebuild soak
 """
 
 from __future__ import annotations
@@ -37,6 +38,15 @@ def main(argv=None) -> int:
                    help="state dir (default: a fresh temp dir per plan)")
     p.add_argument("--verify-repro", action="store_true",
                    help="run each plan twice; event logs must be identical")
+    p.add_argument("--kill-blobnode", action="store_true",
+                   help="run the kill-a-blobnode rebuild scenario (instead "
+                        "of the fault plans unless --plan is also given): "
+                        "kills one node under live PUT load and FAILS if "
+                        "rebuild throughput is zero, any repaired stripe "
+                        "miscompares, or a WORKING task is stranded")
+    p.add_argument("--hb-timeout", type=float, default=0.75,
+                   help="heartbeat-silence window for the kill scenario's "
+                        "dead-disk detection (seconds)")
     p.add_argument("--sanitize", action="store_true",
                    help="arm the lock-order sanitizer (CFS_LOCK_SANITIZER=1) "
                         "for the whole soak; any lock inversion observed "
@@ -49,11 +59,23 @@ def main(argv=None) -> int:
         # CONSTRUCTED, so this must precede every component import-and-build
         os.environ["CFS_LOCK_SANITIZER"] = "1"
 
-    from chubaofs_tpu.chaos.soak import SoakFailure, run_soak
+    from chubaofs_tpu.chaos.soak import SoakFailure, run_kill_soak, run_soak
 
-    plans = args.plan or ACCEPTANCE_PLANS
+    plans = args.plan or ([] if args.kill_blobnode else ACCEPTANCE_PLANS)
     results = []
     ok = True
+    if args.kill_blobnode:
+        root = (os.path.join(args.root, "kill-blobnode") if args.root
+                else tempfile.mkdtemp(prefix="chaos-kill-"))
+        try:
+            res = run_kill_soak(root, seed=args.seed, n_nodes=args.nodes,
+                                disks_per_node=args.disks_per_node,
+                                hb_timeout=args.hb_timeout)
+        except SoakFailure as e:
+            ok = False
+            res = {"plan": "kill_blobnode", "seed": args.seed, "ok": False,
+                   "error": str(e)}
+        results.append(res)
     for plan in plans:
         runs = 2 if args.verify_repro else 1
         logs = []
@@ -96,10 +118,20 @@ def main(argv=None) -> int:
     else:
         for r in results:
             status = "OK " if r.get("ok") else "FAIL"
-            extra = (f"puts={r.get('puts')} rejected={r.get('puts_rejected')}"
-                     f" gets={r.get('gets')}"
-                     f" max_get={r.get('max_get_s', 0):.2f}s"
-                     if r.get("ok") else r.get("error", ""))
+            if not r.get("ok"):
+                extra = r.get("error", "")
+            elif r.get("plan") == "kill_blobnode":
+                extra = (f"killed={r['killed_node']} "
+                         f"detect={r['detect_s']}s "
+                         f"rebuilt={r['rebuilt_shards']} shards "
+                         f"({r['rebuild_shards_per_s']}/s) "
+                         f"overlap={r['repair_overlap_ratio']} "
+                         f"bytes/shard={r['bytes_per_repaired_shard']}")
+            else:
+                extra = (f"puts={r.get('puts')} "
+                         f"rejected={r.get('puts_rejected')}"
+                         f" gets={r.get('gets')}"
+                         f" max_get={r.get('max_get_s', 0):.2f}s")
             print(f"[{status}] plan={r['plan']} seed={r.get('seed')} {extra}")
             for ev in r.get("events") or []:
                 print(f"         t={ev['t']} {ev['event']} {ev['fault']}"

@@ -28,6 +28,9 @@ DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                    0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
 # size/count buckets for batch-occupancy summaries (raft drain, codec batches)
 BATCH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+# buckets for values in [0, 1] (overlap/occupancy ratios) — count buckets
+# would dump every ratio into the first bucket and flatten the histogram
+RATIO_BUCKETS = (0.1, 0.25, 0.5, 0.75, 0.9, 1.0)
 
 
 def _key(name: str, labels: dict[str, str] | None) -> tuple:

@@ -417,7 +417,8 @@ def test_failed_disk_repair_retried_after_failure(cluster, rng):
 
     # poison the worker so every attempt fails
     orig = cluster.worker._migrate_disk
-    cluster.worker._migrate_disk = lambda task: (_ for _ in ()).throw(RuntimeError("net down"))
+    cluster.worker._migrate_disk = \
+        lambda task, lease=None: (_ for _ in ()).throw(RuntimeError("net down"))
     for _ in range(4):
         cluster.run_background_once()
     failed = [t for t in cluster.scheduler.tasks(sched_mod.KIND_DISK_REPAIR)

@@ -45,6 +45,25 @@ def test_put_pipeline_bench_smoke_floor(tmp_path):
         assert out[k] > 0, (k, out)
 
 
+def test_repair_bench_smoke_floor(tmp_path):
+    """Tier-1 repair gate (ISSUE 7 satellite): the repair A/B bench at smoke
+    size must rebuild the same row count on both arms, report nonzero
+    stripes/s, and realize a NONZERO download/decode overlap ratio on the
+    windowed arm (the pipeline really overlapped survivor downloads with
+    device decode). Speedup floors stay in PERF.md — CI co-tenant noise."""
+    from chubaofs_tpu.tools.perfbench import bench_repair
+
+    out = bench_repair(str(tmp_path), n_nodes=6, disks_per_node=2,
+                       stripes=6, blob_kb=256, wire_ms=2.0, window=4)
+    assert out["repair_rows_serial"] > 0, out
+    assert out["repair_rows_pipelined"] == out["repair_rows_serial"], out
+    assert out["repair_stripes_s_serial"] > 0, out
+    assert out["repair_stripes_s_pipelined"] > 0, out
+    assert out["repair_speedup"] > 0, out
+    assert out["repair_overlap_ratio"] > 0, out
+    assert out["repair_bytes_per_shard"] > 0, out
+
+
 @pytest.mark.slow
 def test_perfbench_tool_runs_and_gates(tmp_path):
     # own session so a timeout kill reaps the 7 daemon GRANDCHILDREN too —
