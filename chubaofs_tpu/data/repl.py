@@ -7,9 +7,14 @@ follower acks arrive (repl_protocol.go:190-219, follower check :155-160).
 
 Kept here: the same leader pipeline with the forward overlapped against the
 local operate (send to all followers first, operate, then collect acks — the
-goroutine-pair overlap collapsed to one thread per client connection), pooled
-follower connections, and the RemainingFollowers byte cleared on forwarded
-packets. The operator itself is injected by the datanode."""
+goroutine-pair overlap collapsed to one worker task per client connection),
+pooled follower connections, and the RemainingFollowers byte cleared on
+forwarded packets. The operator itself is injected by the datanode.
+
+Serving rides the rpc/evloop.py event-loop core by default (ISSUE 8): loop
+shards own the sockets, the blocking dispatch runs on the bounded worker
+pool, per-connection order is preserved. `CFS_EVLOOP=0` restores the
+thread-per-connection accept loop below for A/B and rollback."""
 
 from __future__ import annotations
 
@@ -19,6 +24,7 @@ import threading
 from chubaofs_tpu.proto.packet import (
     Packet, RES_OK, recv_packet, send_packet,
 )
+from chubaofs_tpu.rpc.evloop import EvloopServer, evloop_enabled
 from chubaofs_tpu.utils.conn_pool import ConnPool
 
 
@@ -50,23 +56,31 @@ class ReplServer:
             self.addr = f"{host}:{self._listener.getsockname()[1]}"
         self._stop = threading.Event()
         self._accept_thread: threading.Thread | None = None
+        self._evloop: EvloopServer | None = None
 
     # -- server side -----------------------------------------------------------
 
     def start(self) -> None:
         self._listener.listen(128)
+        if evloop_enabled():
+            self._evloop = EvloopServer(self._listener, self.dispatch,
+                                        name="repl")
+            self._evloop.start()
+            return
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True, name=f"repl-{self.addr}")
         self._accept_thread.start()
 
     def _accept_loop(self) -> None:
+        """CFS_EVLOOP=0 shim: the pre-evloop thread-per-connection path."""
         while not self._stop.is_set():
             try:
                 conn, _ = self._listener.accept()
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            threading.Thread(target=self._serve_conn, args=(conn,), daemon=True).start()
+            threading.Thread(  # racelint: CFS_EVLOOP=0 rollback shim — evloop is the default serving path
+                target=self._serve_conn, args=(conn,), daemon=True).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
         """ServerConn analog (repl_protocol.go:219): packets in order per conn."""
@@ -82,6 +96,8 @@ class ReplServer:
 
     def stop(self) -> None:
         self._stop.set()
+        if self._evloop is not None:
+            self._evloop.stop()
         try:
             self._listener.shutdown(socket.SHUT_RDWR)
         except OSError:

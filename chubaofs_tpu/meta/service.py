@@ -41,6 +41,7 @@ from chubaofs_tpu.proto.packet import (
     trace_reply,
 )
 from chubaofs_tpu.raft.server import NotLeaderError
+from chubaofs_tpu.rpc.evloop import EvloopServer, evloop_enabled
 from chubaofs_tpu.utils.auditlog import record_slow_op
 from chubaofs_tpu.utils.exporter import registry
 
@@ -72,17 +73,28 @@ class MetaService:
         self.listener = socket.create_server((host, port))
         self.addr = f"{host}:{self.listener.getsockname()[1]}"
         self._stop = threading.Event()
-        self._thread = threading.Thread(target=self._accept, daemon=True)
-        self._thread.start()
+        self._evloop: EvloopServer | None = None
+        if evloop_enabled():
+            # serving on the shared event-loop core: loop shards own the
+            # sockets, _handle runs on the bounded worker pool (it blocks on
+            # raft commits), per-connection order preserved
+            self._evloop = EvloopServer(self.listener, self._handle,
+                                        name="meta")
+            self._evloop.start()
+        else:
+            self._thread = threading.Thread(target=self._accept, daemon=True)
+            self._thread.start()
 
     def _accept(self):
+        """CFS_EVLOOP=0 shim: the pre-evloop thread-per-connection path."""
         while not self._stop.is_set():
             try:
                 conn, _ = self.listener.accept()
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+            threading.Thread(  # racelint: CFS_EVLOOP=0 rollback shim — evloop is the default serving path
+                target=self._serve, args=(conn,), daemon=True).start()
 
     def _serve(self, conn: socket.socket):
         try:
@@ -168,6 +180,8 @@ class MetaService:
 
     def close(self):
         self._stop.set()
+        if self._evloop is not None:
+            self._evloop.stop()
         try:
             self.listener.close()
         except OSError:

@@ -72,6 +72,12 @@ RES_CRC_MISMATCH = 0x06
 _HEADER = struct.Struct("<BBBBIIIQQQQQ")
 HEADER_SIZE = _HEADER.size  # 56 bytes
 
+# receive-side sanity bounds on the header's u32 length fields. The largest
+# legit payload is a multi-MiB extent/shard write; 64 MiB leaves generous
+# headroom while keeping a hostile header from preallocating 4 GiB.
+MAX_DATA_LEN = 64 << 20
+MAX_ARG_LEN = 16 << 20
+
 TINY_EXTENT_COUNT = 64  # storage/extent_store.go:613-694: 64 shared tiny extents
 TINY_EXTENT_MAX_ID = TINY_EXTENT_COUNT  # ids 1..64 are tiny, >=65 normal
 
@@ -131,6 +137,12 @@ class Packet:
          pid, eid, eoff, koff, req_id) = _HEADER.unpack(hdr)
         if magic != MAGIC:
             raise ProtoError(f"bad magic {magic:#x}")
+        # bound the u32 length fields BEFORE anyone preallocates: both
+        # receive paths (_recv_exact, PacketFramer.arm_stage) size a buffer
+        # straight from the header, so an unchecked size=0xFFFFFFFF is a
+        # 4 GiB allocation per corrupt/hostile connection
+        if size > MAX_DATA_LEN or arg_len > MAX_ARG_LEN:
+            raise ProtoError(f"oversized packet: data={size} arg={arg_len}")
         pkt = cls(opcode=opcode, partition_id=pid, extent_id=eid,
                   extent_offset=eoff, kernel_offset=koff, result=result,
                   remaining_followers=followers, req_id=req_id, crc=crc)
@@ -209,20 +221,83 @@ def trace_merge(resp: "Packet") -> None:
 
 
 # -- socket framing ---------------------------------------------------------------
+#
+# Zero-copy discipline (ISSUE 8): a multi-MB shard payload crosses this layer
+# without a single Python-level copy in either direction. Sending hands the
+# kernel an iovec of (header, arg, data) memoryviews via sendmsg — never
+# `hdr + arg + data` concatenation, which would materialize the payload a
+# second time. Receiving preallocates ONE bytearray of the exact size and
+# fills it in place with recv_into — the old bytearray-accumulate-then-
+# `bytes(buf)` path copied every payload twice (growth reallocs + the final
+# freeze). Received payloads stay bytearray: every consumer (crc32, file
+# writes, raft codec, json.loads, slice-assign into read buffers) takes any
+# buffer object, and the freeze-to-bytes copy bought nothing.
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray()
-    while len(buf) < n:
-        chunk = sock.recv(n - len(buf))
-        if not chunk:
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    """Read exactly n bytes into a preallocated buffer, filled in place."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:])
+        if r == 0:
             raise ConnectionError("peer closed")
-        buf += chunk
-    return bytes(buf)
+        got += r
+    return buf
+
+
+def packet_iov(pkt: Packet) -> list:
+    """The packet as a (header, arg, data) iovec — framing WITHOUT the
+    payload concat `encode()` pays. The data element is a memoryview of the
+    caller's buffer; nothing is copied."""
+    arg_blob = json.dumps(pkt.arg).encode() if pkt.arg else b""
+    hdr = _HEADER.pack(
+        MAGIC, pkt.opcode, pkt.result, pkt.remaining_followers,
+        pkt.crc, len(pkt.data), len(arg_blob),
+        pkt.partition_id, pkt.extent_id, pkt.extent_offset,
+        pkt.kernel_offset, pkt.req_id,
+    )
+    iov = [hdr]
+    if arg_blob:
+        iov.append(arg_blob)
+    if pkt.data:
+        iov.append(memoryview(pkt.data))
+    return iov
+
+
+def advance_iov(views: list, sent: int) -> list:
+    """Drop `sent` bytes off the front of a memoryview iovec and return the
+    remainder — THE pointer-advance for every partial-send site (blocking
+    sendmsg_all, the evloop's direct send and shard flush all share it, so
+    a boundary fix lands everywhere at once)."""
+    i = 0
+    for v in views:
+        if sent < len(v):
+            break
+        sent -= len(v)
+        i += 1
+    rest = views[i:]
+    if rest and sent:
+        rest[0] = rest[0][sent:]
+    return [v for v in rest if len(v)]
+
+
+def sendmsg_all(sock: socket.socket, iov: list) -> None:
+    """Drain an iovec through sendmsg, advancing memoryviews across partial
+    sends — the writev analog. No buffer is ever joined."""
+    views = [memoryview(b) for b in iov]
+    while views:
+        views = advance_iov(views, sock.sendmsg(views))
 
 
 def send_packet(sock: socket.socket, pkt: Packet) -> None:
-    sock.sendall(pkt.encode())
+    iov = packet_iov(pkt)
+    if hasattr(sock, "sendmsg"):
+        sendmsg_all(sock, iov)
+    else:  # sendmsg-less socket (test doubles, exotic platforms)
+        for buf in iov:
+            sock.sendall(buf)
 
 
 def recv_packet(sock: socket.socket) -> Packet:
@@ -232,3 +307,48 @@ def recv_packet(sock: socket.socket) -> Packet:
     if size:
         pkt.data = _recv_exact(sock, size)
     return pkt
+
+
+class PacketFramer:
+    """Incremental packet codec — the event loop's per-connection read state
+    machine, and the SAME framing recv_packet performs blockingly: header →
+    arg blob → data payload, each stage a preallocated buffer the loop fills
+    with non-blocking recv_into calls (partial reads resume where they
+    stopped). The data-stage buffer BECOMES pkt.data — zero copies on the
+    receive path, same as the blocking side.
+
+    Contract (rpc/evloop.py consumes it): `need()` says how many bytes the
+    next stage wants; the loop hands back the exact-size filled buffer via
+    `feed(buf)`, which returns a completed Packet or None (mid-message).
+    Malformed input raises ProtoError — the connection is dropped."""
+
+    def __init__(self):
+        self._pkt: Packet | None = None
+        self._arg_len = 0
+        self._size = 0
+        self._stage = "hdr"
+
+    def need(self) -> int:
+        if self._stage == "hdr":
+            return HEADER_SIZE
+        if self._stage == "arg":
+            return self._arg_len
+        return self._size
+
+    def feed(self, buf: bytearray) -> Packet | None:
+        if self._stage == "hdr":
+            self._pkt, self._arg_len, self._size = Packet.decode_header(buf)
+            self._stage = "arg" if self._arg_len else "data"
+        elif self._stage == "arg":
+            try:
+                self._pkt.arg = json.loads(buf)
+            except ValueError as e:
+                raise ProtoError(f"bad arg blob: {e}") from None
+            self._stage = "data"
+        else:
+            self._pkt.data = buf
+            self._stage = "done"
+        if self._stage == "arg" or (self._stage == "data" and self._size):
+            return None
+        pkt, self._pkt, self._stage = self._pkt, None, "hdr"
+        return pkt

@@ -31,6 +31,7 @@ import threading
 from chubaofs_tpu import chaos
 from chubaofs_tpu.raft import codec
 from chubaofs_tpu.raft.core import Entry, Msg
+from chubaofs_tpu.rpc.evloop import EvloopServer, evloop_enabled
 
 _LEN = struct.Struct("<I")
 MAX_FRAME = 256 << 20  # a snapshot install rides one frame
@@ -80,6 +81,39 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
             raise ConnectionError("peer closed")
         buf += chunk
     return bytes(buf)
+
+
+class _FrameFramer:
+    """Incremental reader for the [u32 len][32B MAC][payload] raft frame —
+    the evloop per-connection state machine twin of the blocking _serve
+    loop. Yields (mac, payload); oversized lengths raise and drop the
+    connection before a byte of the body is bought."""
+
+    def __init__(self):
+        self._stage = "len"
+        self._length = 0
+        self._mac: bytes | None = None
+
+    def need(self) -> int:
+        if self._stage == "len":
+            return _LEN.size
+        if self._stage == "mac":
+            return 32
+        return self._length
+
+    def feed(self, buf: bytearray):
+        if self._stage == "len":
+            (self._length,) = _LEN.unpack(buf)
+            if self._length > MAX_FRAME:
+                raise codec.CodecError("oversized frame")
+            self._stage = "mac"
+            return None
+        if self._stage == "mac":
+            self._mac = bytes(buf)
+            self._stage = "payload"
+            return None
+        mac, self._mac, self._stage = self._mac, None, "len"
+        return (mac, buf)
 
 
 class _PeerLink:
@@ -168,8 +202,20 @@ class TcpNet:
         self.listener = socket.create_server((host, int(port)))
         self.listen_addr = f"{host}:{self.listener.getsockname()[1]}"
         self.peers[node_id] = self.listen_addr
-        self._accept_thread = threading.Thread(target=self._accept, daemon=True)
-        self._accept_thread.start()
+        self._evloop: EvloopServer | None = None
+        if evloop_enabled():
+            # inbound raft frames ride the shared event-loop core: verify +
+            # decode + deliver run on its worker pool (deliver takes node
+            # locks), fire-and-forget so encode=None
+            self._evloop = EvloopServer(self.listener, self._on_frame,
+                                        name="raft",
+                                        framer_factory=_FrameFramer,
+                                        encode=None)
+            self._evloop.start()
+        else:
+            self._accept_thread = threading.Thread(target=self._accept,
+                                                   daemon=True)
+            self._accept_thread.start()
 
     # -- InProcNet surface ----------------------------------------------------
 
@@ -214,14 +260,28 @@ class TcpNet:
         with self._lock:
             self.peers[node_id] = addr
 
+    def _on_frame(self, msg) -> None:
+        """Evloop handler: one (mac, payload) frame — authenticate, decode,
+        deliver. Any failure raises, which drops THAT connection (the
+        blocking _serve loop's `return` on the same conditions)."""
+        mac, payload = msg
+        want = hmac.new(self.secret, payload, hashlib.sha256).digest()
+        if not hmac.compare_digest(mac, want):
+            raise ConnectionError("unauthenticated frame")
+        msgs = _unwire_msgs(codec.loads(payload))  # CodecError et al drop the conn
+        if self.node is not None:
+            self.node.deliver(msgs)
+
     def _accept(self):
+        """CFS_EVLOOP=0 shim: the pre-evloop thread-per-connection path."""
         while not self._stop.is_set():
             try:
                 conn, _ = self.listener.accept()
             except OSError:
                 return
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+            threading.Thread(  # racelint: CFS_EVLOOP=0 rollback shim — evloop is the default serving path
+                target=self._serve, args=(conn,), daemon=True).start()
 
     def _serve(self, conn: socket.socket):
         try:
@@ -250,6 +310,8 @@ class TcpNet:
 
     def close(self):
         self._stop.set()
+        if self._evloop is not None:
+            self._evloop.stop()
         try:
             self.listener.close()
         except OSError:

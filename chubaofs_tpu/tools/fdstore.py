@@ -60,7 +60,8 @@ class FdStore:
                 conn, _ = self.listener.accept()
             except OSError:
                 return
-            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+            threading.Thread(  # racelint: local unix-socket upgrade daemon — a handful of conns, and SCM_RIGHTS ancillary fds don't frame through the evloop
+                target=self._serve, args=(conn,), daemon=True).start()
 
     def _serve(self, conn: socket.socket):
         try:

@@ -28,6 +28,14 @@
    wall-clock arithmetic is cross-process protocol (authnode ticket
    freshness windows) are allowlisted.
 
+5. **No `sock.sendall(pkt.encode())` framing outside the packet layer.**
+   `encode()` concatenates header + arg + a possibly multi-MB payload into
+   one fresh bytes object — the exact copy the zero-copy iovec path
+   (`proto/packet.send_packet` via `sendmsg`) exists to avoid. Call sites
+   use `send_packet` (or queue iovecs through `rpc/evloop.py`); only those
+   two files may hand-frame packet bytes onto a socket. `# obslint: <why>`
+   pragmas an exception.
+
 Wired into tier-1 (tests/test_obslint.py) so a regression fails fast.
 
 File-walk, pragma, and CLI plumbing live in tools/lintcore.py, shared with
@@ -61,6 +69,10 @@ ALLOWED_STATS_DICTS = {
 # the ONE module allowed to construct HTTPConnection: the keep-alive pool
 CONN_POOL_PATH = "rpc/pool.py"
 
+# the packet-framing layer: the only files allowed to sendall(pkt.encode())
+# (rule 5) — everyone else goes through send_packet's sendmsg iovec path
+PACKET_LAYER_PATHS = lintcore.PACKET_LAYER_PATHS
+
 # files whose wall-clock arithmetic is PROTOCOL, not latency: authnode
 # verifies request-timestamp freshness across processes, where monotonic
 # clocks don't compare and wall time is the contract
@@ -75,6 +87,18 @@ def _is_walltime_call(node: ast.expr) -> bool:
             and node.func.attr == "time"
             and isinstance(node.func.value, ast.Name)
             and node.func.value.id.lstrip("_") == "time")
+
+
+def _names_a_packet(node: ast.expr) -> bool:
+    """True when an expression's terminal name reads as a Packet (`pkt`,
+    `reply_packet`, `self.pkt`, ...) — rule 5's receiver filter."""
+    if isinstance(node, ast.Attribute):
+        name = node.attr
+    elif isinstance(node, ast.Name):
+        name = node.id
+    else:
+        return False
+    return "pkt" in name.lower() or "packet" in name.lower()
 
 
 def _labels_arg(call: ast.Call) -> ast.expr | None:
@@ -137,6 +161,24 @@ def lint_source(src: str, relpath: str) -> list[str]:
                 f"{relpath}:{node.lineno}: latency/deadline arithmetic on "
                 "time.time() — the wall clock jumps (NTP, manual set); "
                 "deltas and deadlines use time.monotonic()")
+        # -- rule 5: hand-framed sendall(pkt.encode()) outside the layer ----
+        # only when the encode() receiver NAMES a packet (pkt/packet/...):
+        # sendall(json.dumps(cmd).encode()) and friends are text protocols,
+        # not the shard-payload concat this rule exists for
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "sendall" and len(node.args) == 1 \
+                and isinstance(node.args[0], ast.Call) \
+                and isinstance(node.args[0].func, ast.Attribute) \
+                and node.args[0].func.attr == "encode" \
+                and _names_a_packet(node.args[0].func.value) \
+                and not lintcore.path_matches(relpath, PACKET_LAYER_PATHS) \
+                and not lintcore.has_pragma(src_lines, node.lineno, "obslint"):
+            findings.append(
+                f"{relpath}:{node.lineno}: sendall(<x>.encode()) hand-frames "
+                "a packet through a full payload concat — the zero-copy "
+                "iovec path (proto/packet.send_packet via sendmsg) exists "
+                "so multi-MB shard buffers cross the wire uncopied; use "
+                "send_packet or the evloop write queue")
         # -- rule 2: ad-hoc self.*stats* = {...} dict counters --------------
         if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
             for tgt in node.targets:

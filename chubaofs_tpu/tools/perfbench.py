@@ -28,6 +28,7 @@ from __future__ import annotations
 import json
 import os
 import shutil
+import subprocess
 import sys
 import tempfile
 import time
@@ -461,6 +462,165 @@ def bench_repair(root: str, n_nodes: int = 6, disks_per_node: int = 2,
     return out
 
 
+def _conc_driver(addr: str, n_socks: int, ops: int, payload: int) -> None:
+    """Subprocess body for bench_concurrency's load generator. Runs OUT of
+    the server's process: an in-process driver shares the server's GIL, and
+    at 256+ clients the load generation drowns out the serving-model
+    difference the A/B exists to measure. Protocol with the parent: connect
+    + warm every socket, print READY, block for GO on stdin, run the timed
+    loop, print one JSON line of per-request latencies (ms)."""
+    import socket as _socket
+    import threading
+
+    from chubaofs_tpu.proto.packet import (
+        OP_WRITE, Packet, recv_packet, send_packet)
+
+    host, port = addr.rsplit(":", 1)
+    req = Packet(OP_WRITE, partition_id=1, extent_id=65,
+                 data=b"\xa7" * payload)
+    socks = []
+    for _ in range(n_socks):
+        s = _socket.create_connection((host, int(port)))
+        s.settimeout(60)
+        s.setsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY, 1)
+        send_packet(s, req)  # warm: conn registration, framer state
+        recv_packet(s)
+        socks.append(s)
+    print("READY", flush=True)
+    sys.stdin.readline()  # GO
+    n_threads = max(1, min(8, n_socks))
+    chunks = [socks[t::n_threads] for t in range(n_threads)]
+    lats: list[list[float]] = [[] for _ in range(n_threads)]
+
+    def run(t: int) -> None:
+        mine, out = chunks[t], lats[t]
+        t0s = [0.0] * len(mine)
+        for _ in range(ops):
+            for i, s in enumerate(mine):  # one in-flight request per socket
+                t0s[i] = time.perf_counter()
+                send_packet(s, req)
+            for i, s in enumerate(mine):
+                recv_packet(s)
+                out.append(time.perf_counter() - t0s[i])
+
+    threads = [threading.Thread(target=run, args=(t,), daemon=True)
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for s in socks:
+        s.close()
+    print(json.dumps([round(x * 1000.0, 3) for chunk in lats
+                      for x in chunk]), flush=True)
+
+
+_CONC_DRIVER_CMD = (
+    "import sys\n"
+    "from chubaofs_tpu.tools.perfbench import _conc_driver\n"
+    "_conc_driver(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]),"
+    " int(sys.argv[4]))\n")
+
+
+def bench_concurrency(clients_axis: tuple = (64, 256, 1024),
+                      ops_per_client: int = 20, payload: int = 4096) -> dict:
+    """High fan-in packet-serving A/B (ISSUE 8): ops/s and p99 latency at
+    64/256/1024 concurrent packet connections, event-loop serving vs the
+    CFS_EVLOOP=0 thread-per-connection baseline, against a real ReplServer
+    whose dispatch does representative per-op work (CRC verify + small
+    reply). The client harness is identical in both phases — up to 4
+    subprocess drivers (own GIL each, see _conc_driver) with 8 threads
+    apiece, one in-flight request per socket — so the only variable is the
+    serving model. Per-request latency is measured send→reply per socket;
+    p99 over every request of the phase, so fan-in queueing (the thing
+    thread stacks and GIL churn inflate) lands in the number."""
+    from chubaofs_tpu.data.repl import ReplServer
+    from chubaofs_tpu.proto.packet import Packet, RES_OK
+
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+
+    def dispatch(pkt: Packet) -> Packet:
+        # representative op cost: payload CRC + a small ack (the datanode
+        # write path's shape without the disk)
+        ok = pkt.verify_crc()
+        return pkt.reply(RES_OK if ok else 1, data=bytes(pkt.data[:32]))
+
+    def phase(mode: str, n_clients: int) -> tuple[float, float]:
+        prev_env = os.environ.get("CFS_EVLOOP")
+        os.environ["CFS_EVLOOP"] = "1" if mode == "evloop" else "0"
+        srv = None
+        procs: list[subprocess.Popen] = []
+        try:
+            srv = ReplServer("127.0.0.1:0", dispatch)
+            srv.start()
+            n_procs = max(1, min(4, n_clients // 16))
+            per = n_clients // n_procs
+            env = dict(os.environ)
+            env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+            procs = [
+                subprocess.Popen(
+                    [sys.executable, "-c", _CONC_DRIVER_CMD, srv.addr,
+                     str(per), str(ops_per_client), str(payload)],
+                    stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                    env=env, text=True)
+                for _ in range(n_procs)
+            ]
+            for p in procs:  # all sockets connected + warmed before the clock
+                if p.stdout.readline().strip() != "READY":
+                    raise RuntimeError(
+                        f"concurrency driver died during warm-up "
+                        f"({mode}, {n_clients}c)")
+            t0 = time.perf_counter()
+            for p in procs:
+                p.stdin.write("GO\n")
+                p.stdin.flush()
+            all_lats: list[float] = []
+            for p in procs:
+                line = p.stdout.readline()
+                if not line.strip():
+                    raise RuntimeError(
+                        f"concurrency driver died mid-run "
+                        f"({mode}, {n_clients}c)")
+                all_lats.extend(json.loads(line))
+            dt = time.perf_counter() - t0
+            for p in procs:
+                p.wait(timeout=30)
+            if len(all_lats) != n_procs * per * ops_per_client:
+                raise RuntimeError(
+                    f"concurrency driver dropped requests "
+                    f"({mode}, {n_clients}c): {len(all_lats)}")
+            all_lats.sort()
+            p99 = all_lats[min(len(all_lats) - 1, int(0.99 * len(all_lats)))]
+            return len(all_lats) / dt, p99
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            if srv is not None:
+                srv.stop()
+            if prev_env is None:
+                os.environ.pop("CFS_EVLOOP", None)
+            else:
+                os.environ["CFS_EVLOOP"] = prev_env
+
+    out: dict = {}
+    for n in clients_axis:
+        for mode in ("threads", "evloop"):
+            ops, p99 = phase(mode, n)
+            out[f"conc_ops_{n}c_{mode}"] = round(ops, 1)
+            out[f"conc_p99_ms_{n}c_{mode}"] = round(p99, 2)
+            log(f"  concurrency {n}c {mode}: {out[f'conc_ops_{n}c_{mode}']} "
+                f"ops/s, p99 {out[f'conc_p99_ms_{n}c_{mode}']} ms")
+        out[f"conc_speedup_{n}c"] = round(
+            out[f"conc_ops_{n}c_evloop"]
+            / max(0.001, out[f"conc_ops_{n}c_threads"]), 2)
+        out[f"conc_p99_ratio_{n}c"] = round(
+            out[f"conc_p99_ms_{n}c_evloop"]
+            / max(0.001, out[f"conc_p99_ms_{n}c_threads"]), 2)
+    return out
+
+
 def run(root: str, n_files: int = 600, n_clients: int = 4,
         stream_mb: int = 64, metanodes: int = 3, datanodes: int = 3) -> dict:
     from chubaofs_tpu.testing.harness import ProcCluster
@@ -484,10 +644,20 @@ def run(root: str, n_files: int = 600, n_clients: int = 4,
         cfg.update(bench_stream(cluster, "perf", stream_mb))
         log("small files (tiny.md analog)...")
         cfg.update(bench_smallfile(cluster, "perf", max(100, n_files // 4)))
-        _dump_metrics(cfg)
-        return cfg
     finally:
         cluster.close()
+    # the sweep saturates every core for a minute and CPU-throttled hosts
+    # recover slowly, so it must run AFTER the cluster phases or their
+    # throughput floors deflate ~2x; its own A/B is phase-internal, so
+    # position costs it nothing. It also scales with n_files like the other
+    # phases — smoke-size invocations get a smoke-size sweep.
+    log("concurrent-connection sweep (evloop vs threaded A/B)...")
+    if n_files >= 300:
+        cfg.update(bench_concurrency())
+    else:
+        cfg.update(bench_concurrency(clients_axis=(64, 256), ops_per_client=6))
+    _dump_metrics(cfg)
+    return cfg
 
 
 def _dump_metrics(cfg: dict) -> None:

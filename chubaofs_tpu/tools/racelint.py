@@ -37,6 +37,14 @@ mistakes that actually bite that kind of code:
    are separate bytecodes, and another thread can interleave between them.
    Locals are exempt (unshared by construction).
 
+5. **Thread-per-connection serving** (`thread-per-conn`). A
+   `threading.Thread(target=..., args=(conn,...))` spawned per accepted
+   connection is the scaling wall ISSUE 8 removed: at hundreds of clients
+   the thread stacks and GIL churn dominate before the network does.
+   Packet serving rides `rpc/evloop.py` (loop shards + bounded workers);
+   the CFS_EVLOOP=0 rollback shims carry the pragma. `rpc/evloop.py` and
+   `proto/packet.py` are exempt by path (they ARE the sanctioned layer).
+
 Exceptions carry a `# racelint: <why>` pragma on the flagged line, or a
 per-file allowlist entry below — both REQUIRE a written reason. Shared
 walk/pragma/CLI plumbing: tools/lintcore.py. Wired into tier-1
@@ -343,7 +351,43 @@ def lint_source(src: str, relpath: str) -> list[str]:
 
     # -- rule 4: check-then-act on shared dicts outside a lock ----------------
     _scan_check_then_act(tree, module_globals, flag)
+
+    # -- rule 5: thread-per-connection serving --------------------------------
+    _scan_thread_per_conn(tree, relpath, flag)
     return findings
+
+
+# files that ARE the sanctioned serving layer (rule 5)
+_EVLOOP_PATHS = lintcore.PACKET_LAYER_PATHS
+
+# arg names that mark a Thread target as per-connection serving
+_CONNISH = ("conn", "sock", "client", "peer")
+
+
+def _scan_thread_per_conn(tree: ast.AST, relpath: str, flag) -> None:
+    """Rule 5: `threading.Thread(target=..., args=(conn,...))` — one thread
+    per accepted connection. The evloop core replaced this; only the
+    CFS_EVLOOP=0 shims (pragma'd) and evloop/packet themselves may spawn
+    per-connection service threads."""
+    if lintcore.path_matches(relpath, _EVLOOP_PATHS):
+        return
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and _thread_call_kind(node) == "thread"):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "args" or not isinstance(kw.value, ast.Tuple):
+                continue
+            names = [e.id.lower() for e in kw.value.elts
+                     if isinstance(e, ast.Name)]
+            if any(any(t in n for t in _CONNISH) for n in names):
+                flag("thread-per-conn", node.lineno,
+                     "thread-per-connection serving — a full OS thread per "
+                     "accepted conn is the scale wall the evloop removed "
+                     "(ISSUE 8); register the socket on rpc/evloop.py's "
+                     "loop shards instead, or pragma the CFS_EVLOOP=0 shim "
+                     "with its reason")
+                break
 
 
 def _assign_target_of(tree: ast.AST, call: ast.Call) -> ast.expr | None:

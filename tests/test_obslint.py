@@ -112,3 +112,29 @@ def test_walltime_allowlist_and_pragma():
               "def f(ttl):\n"
               "    return time.time() + ttl  # wallclock: protocol stamp\n")
     assert obslint.lint_source(pragma, "elsewhere.py") == []
+
+
+def test_flags_sendall_of_encoded_packet():
+    import textwrap
+
+    src = textwrap.dedent("""
+        def push(sock, pkt):
+            sock.sendall(pkt.encode())
+    """)
+    findings = obslint.lint_source(src, "sdk/somewhere.py")
+    assert len(findings) == 1 and "sendall" in findings[0]
+    # the packet layer itself is exempt (it IS the sendmsg/sendall impl)
+    assert obslint.lint_source(src, "proto/packet.py") == []
+    assert obslint.lint_source(src, "rpc/evloop.py") == []
+    # pragma with a reason documents an exception
+    pragma = ("def push(sock, pkt):\n"
+              "    sock.sendall(pkt.encode())  # obslint: tiny admin frame\n")
+    assert obslint.lint_source(pragma, "sdk/somewhere.py") == []
+    # sendall of a plain buffer (not .encode()) is not this rule's business
+    plain = "def push(sock, buf):\n    sock.sendall(buf)\n"
+    assert obslint.lint_source(plain, "sdk/somewhere.py") == []
+    # text/JSON protocols encode strings, not Packets — not this rule either
+    text = ("def push(sock, cmd):\n"
+            "    sock.sendall(json.dumps(cmd).encode())\n"
+            "    sock.sendall(line.encode())\n")
+    assert obslint.lint_source(text, "sdk/somewhere.py") == []

@@ -107,3 +107,18 @@ def test_perfbench_tool_runs_and_gates(tmp_path):
     assert cfg["put_overlap_ratio_avg"] > 0, cfg
     assert cfg["rpc_pool_hit_rate"] > 0.9, cfg
     assert cfg["put_pipeline_speedup_wire"] > 0, cfg
+
+
+def test_concurrency_bench_smoke_floor():
+    """Tier-1 evloop gate (ISSUE 8 satellite): the concurrency A/B at smoke
+    size must serve every packet of BOTH modes correctly — the phase
+    asserts reply-count and per-request accounting internally — and report
+    sane rates. Speedup floors live in PERF.md, not CI (co-tenant noise);
+    correctness-at-fan-in is what gates here."""
+    from chubaofs_tpu.tools.perfbench import bench_concurrency
+
+    out = bench_concurrency(clients_axis=(16,), ops_per_client=5)
+    assert out["conc_ops_16c_evloop"] > 0, out
+    assert out["conc_ops_16c_threads"] > 0, out
+    assert out["conc_p99_ms_16c_evloop"] > 0, out
+    assert out["conc_speedup_16c"] > 0, out

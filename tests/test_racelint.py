@@ -272,3 +272,53 @@ def test_allowlist_suppresses_per_rule_per_file(monkeypatch):
                 self.n = 0
     """)
     assert len(racelint.lint_source(other, "pkg/tool.py")) == 1
+
+
+# -- rule 5: thread-per-connection serving ------------------------------------
+
+
+def test_flags_thread_per_conn_serving():
+    src = textwrap.dedent("""
+        import threading
+        class Srv:
+            def _accept(self):
+                while True:
+                    conn, _ = self.listener.accept()
+                    threading.Thread(target=self._serve, args=(conn,),
+                                     daemon=True).start()
+    """)
+    findings = racelint.lint_source(src, "x.py")
+    assert any("thread-per-conn" in f for f in findings)
+
+
+def test_thread_per_conn_exemptions():
+    src = textwrap.dedent("""
+        import threading
+        class Srv:
+            def _accept(self):
+                conn, _ = self.listener.accept()
+                threading.Thread(target=self._serve, args=(conn,),
+                                 daemon=True).start()
+    """)
+    # the sanctioned layer is exempt by path
+    assert racelint.lint_source(src, "rpc/evloop.py") == []
+    # a pragma WITH a reason suppresses (the CFS_EVLOOP=0 shim contract)
+    shim = textwrap.dedent("""
+        import threading
+        class Srv:
+            def _accept(self):
+                conn, _ = self.listener.accept()
+                threading.Thread(  # racelint: CFS_EVLOOP=0 rollback shim
+                    target=self._serve, args=(conn,), daemon=True).start()
+    """)
+    assert racelint.lint_source(shim, "x.py") == []
+    # a non-connection worker arg doesn't trip the rule
+    worker = textwrap.dedent("""
+        import threading
+        class Pump:
+            def start(self):
+                threading.Thread(target=self._run, args=(self.q,),
+                                 daemon=True).start()
+    """)
+    assert all("thread-per-conn" not in f
+               for f in racelint.lint_source(worker, "x.py"))
