@@ -194,7 +194,29 @@ class FuseServer:
 
     # -- mount / serve / unmount ----------------------------------------------
 
+    @staticmethod
+    def _disable_vfork_subprocess() -> None:
+        """An in-process FUSE mount makes CPython's vfork fast path a
+        process-wide deadlock trap: vfork suspends the forking thread —
+        WITH the GIL held — until the child execs, and the child's pre-exec
+        work can touch THIS process's own mount (chdir to a cwd under it,
+        close() of an inherited writable fd sending FLUSH). The kernel then
+        waits for the mount's userspace daemon, which is a Python thread
+        that needs the very GIL the suspended forker holds: child waits on
+        the daemon, daemon waits on the GIL, forker waits on the child.
+        Plain fork() has no such window — the parent resumes immediately
+        and the daemon serves the child's requests normally — so any
+        process that hosts a kernel mount drops the vfork optimization.
+        (Observed live: subprocess.run(cwd=<mountpoint>) under the mount's
+        own process wedged in kernel_clone with every other thread parked
+        on the GIL futex.)"""
+        import subprocess
+
+        if hasattr(subprocess, "_USE_VFORK"):
+            subprocess._USE_VFORK = False
+
     def mount(self) -> None:
+        self._disable_vfork_subprocess()
         self.devfd = os.open("/dev/fuse", os.O_RDWR)
         try:
             opts = (f"fd={self.devfd},rootmode=40000,user_id={os.getuid()},"
@@ -694,14 +716,14 @@ def main(argv=None) -> int:
     p.add_argument("mountpoint")
     args = p.parse_args(argv)
     if not fuse_available():
-        print("/dev/fuse unavailable", flush=True)
+        print("/dev/fuse unavailable", flush=True)  # obslint: cfs-fuse CLI entry; stdout is the interface
         return 1
     from chubaofs_tpu.utils.shutdown import await_shutdown, shutdown_event
 
     stop = shutdown_event()
     srv = mount_volume(args.master, args.volume, args.mountpoint,
                        access_addrs=args.access or None)
-    print(f'{{"mounted": "{args.mountpoint}", "volume": "{args.volume}"}}',
+    print(f'{{"mounted": "{args.mountpoint}", "volume": "{args.volume}"}}',  # obslint: mount line IS the stdout protocol (scripts parse it)
           flush=True)
     await_shutdown(stop)
     srv.unmount()

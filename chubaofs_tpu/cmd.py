@@ -59,7 +59,10 @@ def _advertise(addr: str, cfg: dict) -> str:
 
 
 def _log(daemon: str, msg: str) -> None:
-    print(f"[{daemon}] {msg}", file=sys.stderr, flush=True)
+    # stderr IS this process's log transport: supervisors and the harness
+    # redirect it to the daemon's .log file, which log collectors tail
+    print(f"[{daemon}] {msg}",  # obslint: stderr is the captured daemon log
+          file=sys.stderr, flush=True)
 
 
 def _stats_server(cfg: dict, module: str) -> RPCServer:
@@ -867,7 +870,7 @@ def main(argv: list[str] | None = None) -> int:
     stats_addr = getattr(daemon, "stats_addr", "")
     if stats_addr:
         boot["stats_addr"] = stats_addr  # /metrics side-door (statsListen)
-    print(json.dumps(boot), flush=True)
+    print(json.dumps(boot), flush=True)  # obslint: boot line IS the stdout protocol (harness parses it)
     # SIGTERM (supervisors, ProcCluster.close) must run the same graceful
     # stop as ^C: the client role in particular holds a KERNEL MOUNT that
     # outlives the process unless unmounted here

@@ -196,6 +196,32 @@ class Console:
                  "spans": sorted(spans.values(),
                                  key=lambda r: r.get("start", 0.0))})
 
+        def health_rollup(req: Request):
+            """The cluster health verdict: every known target's /health,
+            rolled up worst-status-wins. An UNREACHABLE target is reported
+            AS FAILING — a daemon that can't answer "are you healthy?" is
+            the opposite of omittable, and silently dropping it would let a
+            dead gateway render an all-green dashboard."""
+            from chubaofs_tpu.utils.slo import FAILING, OK, RANK
+
+            targets, missed = [], []
+            worst = OK
+            for addr, out in _fanout("/health"):
+                if out is None or "status" not in out:
+                    missed.append(addr)
+                    entry = {"target": addr, "status": FAILING,
+                             "reasons": ["unreachable"], "slos": {}}
+                else:
+                    entry = {"target": addr, **out}
+                targets.append(entry)
+                if RANK.get(entry["status"], RANK[FAILING]) > RANK[worst]:
+                    # an unknown status string counts as failing too: a
+                    # half-broken daemon must not launder itself to ok
+                    worst = (entry["status"]
+                             if entry["status"] in RANK else FAILING)
+            return Response.json({"status": worst, "targets": targets,
+                                  "unreachable": missed})
+
         def slowops_rollup(req: Request):
             """Recent slow-op audit entries from every daemon, each tagged
             with its source target — what `cfs-stat --slowops` renders next
@@ -213,6 +239,7 @@ class Console:
 
         r.get("/api/overview", overview)
         r.get("/api/metrics", metrics_rollup)
+        r.get("/api/health", health_rollup)
         r.get("/api/trace", trace_rollup)
         r.get("/api/slowops", slowops_rollup)
         r.post("/graphql", graphql_proxy)
@@ -233,7 +260,7 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     host, port = args.listen.rsplit(":", 1)
     console = Console(args.addr, host=host, port=int(port))
-    print(json.dumps({"console": console.addr}), flush=True)
+    print(json.dumps({"console": console.addr}), flush=True)  # obslint: boot line IS the stdout protocol (harness parses it)
     try:
         while True:
             time.sleep(3600)

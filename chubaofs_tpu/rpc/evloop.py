@@ -89,6 +89,19 @@ _INSTANCE_IDS = count()
 # high/low-water backpressure, so neither direction can balloon memory.
 _WRITE_HWM = 8 << 20
 
+# loop-lag heartbeat: each shard expects to pass through select() at least
+# every _LAG_TICK seconds; how LATE the tick actually fires is the shard's
+# scheduling lag — the saturation signal (a shard stuck flushing one conn's
+# burst, or starved by the GIL, shows up as lag long before conns error).
+# The tick equals the idle select timeout, so an IDLE shard's wakeup
+# cadence is exactly what it was before the tick existed — the lag meter
+# adds observations, not wakeups.
+_LAG_TICK = 0.5
+# lag histogram buckets in MILLISECONDS: sub-tick jitter up to multi-second
+# stalls (the same decade ladder the lock-hold histogram uses)
+LAG_BUCKETS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+               250.0, 1000.0)
+
 
 class _Conn:
     """One registered connection: framing state + write queue + dispatch
@@ -205,6 +218,11 @@ class _LoopShard(threading.Thread):
         self._gauge = server.reg.gauge("conns", self.gauge_labels)
         self._bp = server.reg.counter(
             "backpressure", {"srv": server.name, "shard": str(idx)})
+        # cfs_evloop_loop_lag_ms: select-wakeup delay vs the expected tick —
+        # the per-shard saturation histogram cfs-top reads
+        self._lag = server.reg.summary(
+            "loop_lag_ms", {"srv": server.name, "shard": str(idx)},
+            buckets=LAG_BUCKETS)
 
     # -- cross-thread entry points --------------------------------------------
 
@@ -251,8 +269,17 @@ class _LoopShard(threading.Thread):
     # -- loop ------------------------------------------------------------------
 
     def run(self) -> None:
+        # loop-lag tick: how late each pass through select() fires vs the
+        # _LAG_TICK deadline. An idle shard observes ~0; a shard pinned in
+        # one pass (flushing a burst, a huge parse) records the stall.
+        next_tick = time.monotonic() + _LAG_TICK
         while not self.server.stopping.is_set():
-            for key, events in self.sel.select(timeout=0.5):
+            now = time.monotonic()
+            if now >= next_tick:
+                self._lag.observe((now - next_tick) * 1e3)
+                next_tick = now + _LAG_TICK
+            for key, events in self.sel.select(
+                    timeout=min(_LAG_TICK, max(0.0, next_tick - now))):
                 if key.data is None:  # wake pipe
                     try:
                         os.read(self._rx, 4096)

@@ -85,7 +85,14 @@ class RPCServer:
     and `/traces/recent` serve the process trace sink's span records, and
     `/slowops` the recent slow-op audit entries — so the console collector
     and `cfs-trace` can fetch one trace's spans from every daemon it
-    crossed with nothing but the addresses `cfs-stat` already scrapes."""
+    crossed with nothing but the addresses `cfs-stat` already scrapes.
+
+    The health-plane side-doors ride the same mount: `/debug/prof` serves
+    the sampling profiler (`?seconds=N` runs an on-demand capture; bare, it
+    reports the CFS_PROF_HZ continuous profile), `/metrics/history` the
+    bounded snapshot ring with server-side `?rate=1`, and `/health` the SLO
+    evaluation (ok/degraded/failing + reasons) the console `/api/health`
+    rollup and `cfs-top` poll."""
 
     def __init__(self, router: Router, host: str = "127.0.0.1", port: int = 0,
                  registry=None, module: str = "", metrics: bool = True):
@@ -122,15 +129,65 @@ class RPCServer:
 
             return Response.json({"slowops": recent_slowops(r.q_int("n", 100))})
 
+        def debug_prof_route(r):
+            from chubaofs_tpu.utils import profiler
+
+            secs = r.q("seconds")
+            if secs:
+                try:
+                    seconds = float(secs)
+                except ValueError:
+                    return Response.json(
+                        {"error": f"bad ?seconds={secs!r}"}, status=400)
+                try:
+                    hz = float(r.q("hz") or 0) or None
+                except ValueError:
+                    hz = None
+                prof = profiler.capture(seconds, hz=hz)
+            else:
+                cont = profiler.active()
+                if cont is None:
+                    return Response.json(
+                        {"error": "continuous profiling disarmed "
+                                  "(set CFS_PROF_HZ) — or pass ?seconds=N "
+                                  "for an on-demand capture"}, status=400)
+                prof = cont.profile
+            if r.q("json"):
+                return Response.json(prof.to_dict())
+            return Response(200, {"Content-Type": "text/plain"},
+                            (prof.collapsed() + "\n").encode())
+
+        def metrics_history_route(r):
+            from chubaofs_tpu.utils import metrichist
+
+            hist = metrichist.default_history()
+            return Response.json(hist.query(n=r.q_int("n", 30),
+                                            flt=r.q("filter"),
+                                            rate=bool(r.q("rate"))))
+
+        def health_route(r):
+            from chubaofs_tpu.utils import slo
+
+            # always HTTP 200: the status FIELD is the verdict, and a 503
+            # would make the console collector count a degraded-but-
+            # answering daemon as unreachable
+            return Response.json(slo.health_report())
+
         if metrics:
             router.get("/metrics", metrics_route)
             router.get("/traces", traces_route)
             router.get("/traces/recent", traces_recent_route)
             router.get("/slowops", slowops_route)
-            # env-armed sampling goes live at daemon boot, not first scrape
-            from chubaofs_tpu.utils import tracesink
+            router.get("/debug/prof", debug_prof_route)
+            router.get("/metrics/history", metrics_history_route)
+            router.get("/health", health_route)
+            # env-armed sinks go live at daemon boot, not first scrape —
+            # and stay the documented no-op when their env knob is unset
+            from chubaofs_tpu.utils import metrichist, profiler, tracesink
 
             tracesink.activate_from_env()
+            profiler.activate_from_env()
+            metrichist.activate_from_env()
 
         outer = self
         self._inflight = 0

@@ -49,19 +49,35 @@ def parse_types(text: str) -> dict[str, str]:
 
 
 def diff_metrics(before: dict[str, float], after: dict[str, float],
-                 interval_s: float) -> list[dict]:
+                 interval_s: float, types: dict[str, str] | None = None) -> list[dict]:
     """Per-metric rows: value now, delta across the window, rate/s.
-    Metrics new in `after` diff against 0; vanished ones are dropped."""
+    Metrics new in `after` diff against 0; vanished ones are dropped.
+
+    With `types` (parse_types of the scrape), a NEGATIVE delta on a
+    monotonic series — a counter or a histogram's _bucket/_sum/_count —
+    means the daemon restarted between the two scrapes: the series restarted
+    from zero, so the post-restart value IS the window's delta. Such rows
+    clamp to that and carry restart=True (rendered as a `(restart)` tag)
+    instead of printing a bogus negative rate. Gauges go down legitimately
+    and are never clamped; without `types` nothing is (the legacy
+    two-plain-dicts library call)."""
+    from chubaofs_tpu.utils.metrichist import is_monotonic
+
     rows = []
     for key in sorted(after):
         b = before.get(key, 0.0)
         a = after[key]
         delta = a - b
+        restart = False
+        if delta < 0 and types is not None and is_monotonic(key, types):
+            delta = a
+            restart = True
         rows.append({
             "metric": key,
             "value": a,
             "delta": round(delta, 6),
             "rate": round(delta / interval_s, 6) if interval_s > 0 else 0.0,
+            "restart": restart,
         })
     return rows
 
@@ -125,7 +141,9 @@ def main(argv=None, out=None) -> int:
         t0 = time.monotonic()
         before = parse_metrics(scrape(args.addr, args.path))
         time.sleep(max(0.0, args.interval))
-        after = parse_metrics(scrape(args.addr, args.path))
+        text = scrape(args.addr, args.path)
+        after = parse_metrics(text)
+        types = parse_types(text)
         elapsed = time.monotonic() - t0
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
@@ -148,7 +166,7 @@ def main(argv=None, out=None) -> int:
             print(f"warning: slowops unavailable: {slow_err}",  # cluster
                   file=sys.stderr)
 
-    rows = diff_metrics(before, after, elapsed)
+    rows = diff_metrics(before, after, elapsed, types=types)
     if args.filter:
         rows = [r for r in rows if args.filter in r["metric"]]
     if args.repair:
@@ -171,8 +189,9 @@ def main(argv=None, out=None) -> int:
         print(f"{'METRIC'.ljust(w)}  {'VALUE':>14}  {'DELTA':>12}  {'RATE/S':>12}",
               file=out)
         for r in rows:
+            tag = "  (restart)" if r.get("restart") else ""
             print(f"{r['metric'].ljust(w)}  {r['value']:>14g}  "
-                  f"{r['delta']:>12g}  {r['rate']:>12g}", file=out)
+                  f"{r['delta']:>12g}  {r['rate']:>12g}{tag}", file=out)
     if args.slowops:
         shown = slowops[-20:]
         note = (f"showing last {len(shown)} of {len(slowops)}"

@@ -1,0 +1,265 @@
+"""cfs-top — live cluster dashboard over the console health/metrics rollup.
+
+The `top(1)` of the observability plane: poll the console's `/api/health`
+(SLO verdicts, unreachable daemons reported as failing) and `/api/metrics`
+(every target's exposition in one scrape), diff adjacent polls, and render
+one row per daemon target:
+
+    TARGET          SLO       PUT/S  GET/S  PUT99MS  CONNS  BP/S  LAG99  CODEC/B  REPAIRQ
+
+  * PUT/S / GET/S — access op completions per second (histogram _count
+    deltas between frames);
+  * PUT99MS — window p99 of the PUT latency histogram (bucket deltas, the
+    SAME math utils/slo.py uses, so the dashboard and /health cannot
+    disagree);
+  * CONNS / BP/S / LAG99 — evloop live connections, read-pause events per
+    second, and the window p99 of `cfs_evloop_loop_lag_ms` (the shard-
+    saturation signal);
+  * CODEC/B — mean codec batch occupancy over the window (jobs per drained
+    device batch — "is the gateway feeding the chip?");
+  * REPAIRQ — repair tasks outstanding (`cfs_scheduler_tasks` gauge sum).
+
+`--once` renders a single frame (two scrapes `--interval` apart) for CI and
+scripts; without it the terminal refreshes in place until ^C. `--addr`
+(repeatable) skips the console and polls daemons' `/health` + `/metrics`
+directly. `--json` emits the frame as JSON instead of the table.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+from chubaofs_tpu.utils.metrichist import (
+    family_sum, hist_delta, hist_quantile, parse_key)
+from chubaofs_tpu.utils.slo import FAILING, RANK
+
+COLUMNS = ("TARGET", "SLO", "PUT/S", "GET/S", "PUT99MS", "CONNS", "BP/S",
+           "LAG99", "CODEC/B", "REPAIRQ")
+
+
+# -- scraping ------------------------------------------------------------------
+
+
+def split_rollup(text: str) -> dict[str, dict[str, float] | None]:
+    """The console /api/metrics rollup -> {target: metrics-or-None}. The
+    rollup tags each section `# == target ADDR ==` and an unreachable one
+    `# == target ADDR UNREACHABLE: ... ==` — those map to None so the
+    dashboard renders the corpse instead of dropping it."""
+    from chubaofs_tpu.tools.cfsstat import parse_metrics
+
+    out: dict[str, dict[str, float] | None] = {}
+    cur: str | None = None
+    body: list[str] = []
+
+    def flush():
+        if cur is not None and out.get(cur, "new") == "new":
+            out[cur] = parse_metrics("\n".join(body))
+
+    for line in text.splitlines():
+        if line.startswith("# == target "):
+            flush()
+            rest = line[len("# == target "):].rstrip("= ").strip()
+            body = []
+            if " UNREACHABLE" in rest:
+                cur = rest.split(" UNREACHABLE", 1)[0].strip()
+                out[cur] = None
+                cur = None  # nothing to parse for this section
+            else:
+                cur = rest
+        else:
+            body.append(line)
+    flush()
+    return out
+
+
+def fetch_frame(console: str | None, addrs: list[str],
+                timeout: float = 5.0) -> dict:
+    """One poll: health verdicts + per-target metrics, stamped monotonic."""
+    from chubaofs_tpu.tools.cfsstat import scrape
+
+    health: dict[str, dict] = {}
+    metrics: dict[str, dict | None] = {}
+    errors: list[str] = []
+    if console:
+        try:
+            roll = json.loads(scrape(console, "/api/health", timeout=timeout))
+            for t in roll.get("targets", ()):
+                health[t.get("target", "?")] = t
+        except Exception as e:
+            errors.append(f"{console}/api/health: {e}")
+        try:
+            metrics = split_rollup(
+                scrape(console, "/api/metrics", timeout=timeout))
+        except Exception as e:
+            errors.append(f"{console}/api/metrics: {e}")
+    else:
+        for addr in addrs:
+            try:
+                health[addr] = {"target": addr, **json.loads(
+                    scrape(addr, "/health", timeout=timeout))}
+            except Exception:
+                health[addr] = {"target": addr, "status": FAILING,
+                                "reasons": ["unreachable"]}
+            try:
+                from chubaofs_tpu.tools.cfsstat import parse_metrics
+
+                metrics[addr] = parse_metrics(
+                    scrape(addr, "/metrics", timeout=timeout))
+            except Exception:
+                metrics[addr] = None
+    return {"mono": time.monotonic(), "health": health, "metrics": metrics,
+            "errors": errors}
+
+
+# -- per-target row math -------------------------------------------------------
+
+
+def _rate(prev: dict, cur: dict, family: str, dt: float) -> float:
+    d = family_sum(cur, family) - family_sum(prev, family)
+    if d < 0:
+        # restart contract (same as metrichist.rates / hist_delta): the
+        # counter restarted from zero, so the post-restart total is the
+        # window's delta — a busy restarted daemon must not render idle
+        d = family_sum(cur, family)
+    return d / dt if dt > 0 else 0.0
+
+
+def _p99(prev: dict, cur: dict, family: str) -> float | None:
+    buckets, count = hist_delta(prev, cur, family)
+    return hist_quantile(buckets, count, 0.99)
+
+
+def compute_row(target: str, prev: dict | None, cur: dict | None,
+                dt: float, health: dict | None) -> dict:
+    """One dashboard row from two metric snapshots of one target."""
+    h = health or {}
+    row: dict = {"target": target, "slo": h.get("status", "?"),
+                 "reasons": h.get("reasons", [])}
+    if cur is None:
+        # no metrics this frame — but the HEALTH verdict stands on its own:
+        # only a target that answered neither surface renders as the
+        # failing corpse. A transient /api/metrics hiccup on an otherwise
+        # ok cluster must not flip every row to 'failing (unreachable)'.
+        if not h or "unreachable" in (h.get("reasons") or ()):
+            row["slo"] = FAILING
+            row["unreachable"] = True
+        return row
+    # state gauges read from the current frame alone
+    row["conns"] = int(family_sum(cur, "cfs_evloop_conns"))
+    row["repair_q"] = int(family_sum(cur, "cfs_scheduler_tasks"))
+    if not prev:
+        # no prior frame for this target (first poll, or its last scrape
+        # failed): a delta against zero would render LIFETIME totals as a
+        # window rate/p99 — a bogus spike; flow cells stay '-' until the
+        # next poll, same no-data discipline as the SLO evaluator
+        return row
+    row["put_s"] = round(_rate(prev, cur, "cfs_access_put_count", dt), 2)
+    row["get_s"] = round(_rate(prev, cur, "cfs_access_get_count", dt), 2)
+    p99 = _p99(prev, cur, "cfs_access_put")
+    row["put99_ms"] = None if p99 is None else round(p99 * 1e3, 2)
+    row["bp_s"] = round(_rate(prev, cur, "cfs_evloop_backpressure", dt), 2)
+    lag = _p99(prev, cur, "cfs_evloop_loop_lag_ms")
+    row["lag99_ms"] = None if lag is None else round(lag, 2)  # already ms
+    # mean jobs per drained codec batch over the window
+    jobs = family_sum(cur, "cfs_codec_batch_jobs_sum") \
+        - family_sum(prev, "cfs_codec_batch_jobs_sum")
+    batches = family_sum(cur, "cfs_codec_batch_jobs_count") \
+        - family_sum(prev, "cfs_codec_batch_jobs_count")
+    row["codec_occ"] = round(jobs / batches, 2) if batches > 0 else None
+    return row
+
+
+def compute_rows(prev_frame: dict, cur_frame: dict) -> list[dict]:
+    dt = cur_frame["mono"] - prev_frame["mono"]
+    targets = list(dict.fromkeys(
+        list(cur_frame["metrics"]) + list(cur_frame["health"])))
+    return [compute_row(t, (prev_frame["metrics"] or {}).get(t),
+                        cur_frame["metrics"].get(t), dt,
+                        cur_frame["health"].get(t))
+            for t in targets]
+
+
+# -- rendering -----------------------------------------------------------------
+
+
+def _cell(v) -> str:
+    if v is None:
+        return "-"
+    return f"{v:g}" if isinstance(v, float) else str(v)
+
+
+def render(rows: list[dict], errors: list[str] = ()) -> str:
+    if not rows:
+        return "(no targets)" + ("".join(f"\n! {e}" for e in errors))
+    worst = max((r["slo"] for r in rows),
+                key=lambda s: RANK.get(s, RANK[FAILING]))
+    cells = [[r["target"], r["slo"] + (" (unreachable)"
+                                       if r.get("unreachable") else ""),
+              _cell(r.get("put_s")), _cell(r.get("get_s")),
+              _cell(r.get("put99_ms")), _cell(r.get("conns")),
+              _cell(r.get("bp_s")), _cell(r.get("lag99_ms")),
+              _cell(r.get("codec_occ")), _cell(r.get("repair_q"))]
+             for r in rows]
+    widths = [max(len(COLUMNS[i]), max(len(row[i]) for row in cells))
+              for i in range(len(COLUMNS))]
+    lines = [f"cluster: {worst}   targets: {len(rows)}   "
+             f"{time.strftime('%H:%M:%S')}"]
+    lines.append("  ".join(c.ljust(w) for c, w in zip(COLUMNS, widths)))
+    for row in cells:
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)))
+    for r in rows:
+        for reason in r.get("reasons", ()):
+            lines.append(f"! {r['target']}: {reason}")
+    for e in errors:
+        lines.append(f"! {e}")
+    return "\n".join(lines)
+
+
+# -- CLI -----------------------------------------------------------------------
+
+
+def main(argv=None, out=None) -> int:
+    import argparse
+
+    out = out or sys.stdout
+    p = argparse.ArgumentParser(
+        prog="cfs-top",
+        description="live cluster dashboard over the console rollup")
+    p.add_argument("--console", default=None,
+                   help="console address (uses /api/health + /api/metrics)")
+    p.add_argument("--addr", action="append", default=[],
+                   help="poll a daemon directly (repeatable; skips console)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="seconds between polls (and the rate window)")
+    p.add_argument("--once", action="store_true",
+                   help="render one frame and exit (CI mode)")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    if not args.console and not args.addr:
+        p.error("give --console or --addr")
+
+    interval = max(0.1, args.interval)
+    prev = fetch_frame(args.console, args.addr)
+    try:
+        while True:
+            time.sleep(interval)
+            cur = fetch_frame(args.console, args.addr)
+            rows = compute_rows(prev, cur)
+            if args.json:
+                print(json.dumps({"rows": rows, "errors": cur["errors"]},
+                                 indent=2), file=out)
+            else:
+                if not args.once and out is sys.stdout:
+                    out.write("\x1b[2J\x1b[H")  # clear + home: live refresh
+                print(render(rows, cur["errors"]), file=out)
+            if args.once:
+                return 0
+            prev = cur
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,13 @@ Usage:
     cfs-trace <trace-id> --addr 127.0.0.1:9500 --addr 127.0.0.1:9600
     cfs-trace <trace-id> --dir /tmp/cfs-traces-1234 --flame
     cfs-trace --top --addr 127.0.0.1:9500
+    cfs-trace --prof 5 --addr 127.0.0.1:9500   # stack-based profile
+
+`--prof N` is the stack-sampled companion to the span-based flamegraph: it
+asks the first --addr's `/debug/prof?seconds=N` side-door (utils/profiler)
+for an on-demand capture and prints the collapsed-stack lines — the same
+`path;to;frame <count>` format `--flame` emits for spans, so both feed the
+same downstream renderers (flamegraph.pl, speedscope).
 
 Also a library: build_tree / critical_path / waterfall / flamegraph /
 aggregate are what the acceptance tests drive.
@@ -430,6 +437,10 @@ def main(argv=None, out=None) -> int:
                    help="read a local trace-sink directory instead of HTTP")
     p.add_argument("--top", action="store_true",
                    help="per-hop p50/p99 over recent traces")
+    p.add_argument("--prof", type=float, default=None, metavar="SECONDS",
+                   help="fetch a SECONDS-long stack-sampled profile from "
+                        "the first --addr's /debug/prof side-door and print "
+                        "its collapsed stacks (flamegraph.pl format)")
     p.add_argument("--n", type=int, default=200,
                    help="recent spans to aggregate with --top")
     p.add_argument("--flame", action="store_true",
@@ -445,6 +456,22 @@ def main(argv=None, out=None) -> int:
                         "overlap)")
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
+
+    if args.prof is not None:
+        if not args.addr:
+            p.error("--prof needs --addr (the daemon to profile)")
+        from chubaofs_tpu.tools.cfsstat import scrape
+
+        path = f"/debug/prof?seconds={args.prof:g}" \
+            + ("&json=1" if args.json else "")
+        try:
+            body = scrape(args.addr[0], path,
+                          timeout=max(30.0, args.prof * 2 + 10.0))
+        except Exception as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        print(body.rstrip("\n"), file=out)
+        return 0
 
     if not args.top and not args.trace_id:
         p.error("a trace id is required unless --top")

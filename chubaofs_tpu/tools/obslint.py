@@ -36,6 +36,15 @@
    two files may hand-frame packet bytes onto a socket. `# obslint: <why>`
    pragmas an exception.
 
+6. **No bare `print(` diagnostics in daemon code.** Outside `tools/` and
+   `cli/` (whose stdout IS the user interface), a print is a log line that
+   no .log file rotates, no level filters, and no operator finds — daemon
+   diagnostics route through `utils/logger.py` or the structured audit
+   trails (`utils/auditlog.py`). The few legitimate prints — a boot line a
+   harness parses off stdout, a structured audit line flushed to stderr —
+   are PROTOCOL, and each carries a reasoned `# obslint: <why>` pragma
+   saying so.
+
 Wired into tier-1 (tests/test_obslint.py) so a regression fails fast.
 
 File-walk, pragma, and CLI plumbing live in tools/lintcore.py, shared with
@@ -77,6 +86,18 @@ PACKET_LAYER_PATHS = lintcore.PACKET_LAYER_PATHS
 # verifies request-timestamp freshness across processes, where monotonic
 # clocks don't compare and wall time is the contract
 ALLOWED_WALLCLOCK_FILES = ("authnode/server.py",)
+
+# directory SEGMENTS whose stdout IS the interface — rule 6 (bare print)
+# does not apply: operator CLIs and the lint/bench tools themselves.
+# Matched as path segments (not prefixes) so linting an installed package
+# (relpath `tools/x.py`) and linting a checkout root (relpath
+# `chubaofs_tpu/tools/x.py`) agree — the same contract as path_matches
+PRINT_OK_DIRS = ("tools", "cli")
+
+
+def _in_print_ok_dir(relpath: str) -> bool:
+    parts = relpath.replace("\\", "/").split("/")
+    return any(seg in PRINT_OK_DIRS for seg in parts[:-1])
 
 
 def _is_walltime_call(node: ast.expr) -> bool:
@@ -179,6 +200,17 @@ def lint_source(src: str, relpath: str) -> list[str]:
                 "iovec path (proto/packet.send_packet via sendmsg) exists "
                 "so multi-MB shard buffers cross the wire uncopied; use "
                 "send_packet or the evloop write queue")
+        # -- rule 6: bare print( diagnostics in daemon code -----------------
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                and node.func.id == "print" \
+                and not _in_print_ok_dir(relpath) \
+                and not lintcore.has_pragma(src_lines, node.lineno, "obslint"):
+            findings.append(
+                f"{relpath}:{node.lineno}: bare print( in daemon code — "
+                "stdout/stderr diagnostics bypass rotation, levels, and "
+                "every log consumer; route through utils/logger.py or the "
+                "structured audit trails, or pragma a protocol line with "
+                "`# obslint: <why>`")
         # -- rule 2: ad-hoc self.*stats* = {...} dict counters --------------
         if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
             for tgt in node.targets:

@@ -145,7 +145,8 @@ def _audit_line(kind: str, rec: dict) -> None:
     harness capture it; never raises (the sanitizer must not break the
     locked path it watches)."""
     try:
-        print(json.dumps({"audit": kind, **rec}), file=sys.stderr, flush=True)
+        print(json.dumps({"audit": kind, **rec}),  # obslint: structured audit line; stderr is the captured daemon log
+              file=sys.stderr, flush=True)
     except Exception:
         pass
 

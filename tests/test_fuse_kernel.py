@@ -58,6 +58,20 @@ def test_kernel_ops_reach_audit_trail(mnt, tmp_path_factory):
     assert "create" in text and "unlink" in text, text
 
 
+def test_mount_disables_vfork_subprocess(mnt):
+    """A process hosting an in-process kernel mount must not use CPython's
+    vfork subprocess fast path: vfork suspends the forking thread with the
+    GIL held until the child execs, and a child touching this very mount
+    (chdir to a cwd under it, FLUSH from closing an inherited fd) then
+    waits on the mount's Python daemon thread — which waits on that GIL.
+    Every shell-tool test in this file forks with cwd on the mount, so a
+    regression here deadlocks the whole suite (observed live: parent in
+    kernel_clone, child in request_wait_answer, 66 threads on the futex)."""
+    import subprocess
+
+    assert getattr(subprocess, "_USE_VFORK", False) is False
+
+
 def test_create_write_read_roundtrip(mnt):
     p = os.path.join(mnt, "hello.txt")
     with open(p, "wb") as f:

@@ -114,6 +114,34 @@ def test_walltime_allowlist_and_pragma():
     assert obslint.lint_source(pragma, "elsewhere.py") == []
 
 
+# -- rule 6: no bare print( diagnostics in daemon code -------------------------
+
+
+def test_flags_bare_print_in_daemon_code():
+    src = "def boot(addr):\n    print('listening on', addr)\n"
+    findings = obslint.lint_source(src, "master/master.py")
+    assert len(findings) == 1 and "print" in findings[0]
+    # stdout IS the interface for operator tools and the CLI — matched as
+    # path SEGMENTS, so an installed-package relpath and a checkout-root
+    # relpath agree (the lintcore path_matches contract)
+    assert obslint.lint_source(src, "tools/cfsstat.py") == []
+    assert obslint.lint_source(src, "cli/main.py") == []
+    assert obslint.lint_source(src, "chubaofs_tpu/tools/cfsstat.py") == []
+    assert obslint.lint_source(src, "chubaofs_tpu/cli/main.py") == []
+    # ...but a FILE merely named tools.py is not an exempt directory
+    assert len(obslint.lint_source(src, "blobstore/tools.py")) == 1
+    # a reasoned pragma documents a protocol line (boot line, audit line)
+    pragma = ("def boot(addr):\n"
+              "    print('x')  # obslint: boot line IS the stdout protocol\n")
+    assert obslint.lint_source(pragma, "master/master.py") == []
+    # a bare tag with no reason does NOT suppress
+    bare = "def boot(a):\n    print('x')  # obslint:\n"
+    assert len(obslint.lint_source(bare, "master/master.py")) == 1
+    # method calls named print (self.print, logger shims) are not this rule
+    method = "def f(self):\n    self.printer.print('x')\n"
+    assert obslint.lint_source(method, "master/master.py") == []
+
+
 def test_flags_sendall_of_encoded_packet():
     import textwrap
 

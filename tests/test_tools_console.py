@@ -210,6 +210,46 @@ def test_console_over_daemon_master(tmp_path):
         master.stop()
 
 
+def test_console_rollup_reports_unreachable_targets(tmp_path):
+    """Partial-failure contract: a target the console can't reach shows up
+    in /api/health AS FAILING and in /api/metrics with an UNREACHABLE
+    marker — never silently dropped (a dead daemon must not render an
+    all-green cluster)."""
+    import urllib.request
+
+    from chubaofs_tpu.console.server import Console
+    from chubaofs_tpu.rpc.router import Router
+    from chubaofs_tpu.rpc.server import RPCServer
+    from chubaofs_tpu.testing.harness import free_port
+
+    srv = RPCServer(Router(), module="partial").start()
+    dead = f"127.0.0.1:{free_port()}"  # reserved-then-released: nobody home
+    console = Console([srv.addr], metrics_addrs=[dead])
+    try:
+        health = json.loads(urllib.request.urlopen(
+            f"http://{console.addr}/api/health", timeout=15).read())
+        assert health["status"] == "failing"
+        assert dead in health["unreachable"]
+        by_target = {t["target"]: t for t in health["targets"]}
+        assert by_target[dead]["status"] == "failing"
+        assert "unreachable" in by_target[dead]["reasons"]
+        assert by_target[srv.addr]["status"] in ("ok", "degraded")
+        # /api/metrics: the corpse is marked, the live target still scrapes
+        text = urllib.request.urlopen(
+            f"http://{console.addr}/api/metrics", timeout=15).read().decode()
+        assert f"target {dead} UNREACHABLE" in text
+        assert f"target {srv.addr} ==" in text
+        # ... and cfs-top's rollup parser keeps the distinction
+        from chubaofs_tpu.tools.cfstop import split_rollup
+
+        sections = split_rollup(text)
+        assert sections[dead] is None
+        assert sections[srv.addr], "live target's metrics parsed empty"
+    finally:
+        console.stop()
+        srv.stop()
+
+
 # -- localcluster (run_docker.sh -r analog) ------------------------------------
 
 
