@@ -190,6 +190,18 @@ class CLI:
     def datanode_decommission(self, args):
         self._emit(self.mc.decommission_node(args.id, "data"))
 
+    def datanode_rebalance(self, args):
+        """One hot-volume spreading sweep (heartbeat-load driven)."""
+        res = self.mc.rebalance_hot(factor=args.factor,
+                                    max_moves=args.max_moves)
+        if self.as_json:
+            return self._emit(res)
+        print(f"moved {res['moved']} replica(s)", file=self.out)
+        rows = [{"id": nid, "window_ops": int(load)}
+                for nid, load in sorted(res["loads"].items(),
+                                        key=lambda kv: int(kv[0]))]
+        table(rows, ["id", "window_ops"], self.out)
+
     # -- partitions ------------------------------------------------------------
 
     def mp_list(self, args):
@@ -310,6 +322,10 @@ def build_parser() -> argparse.ArgumentParser:
     md.set_defaults(fn="metanode_decommission")
     dn = sub.add_parser("datanode").add_subparsers(dest="verb", required=True)
     dn.add_parser("list").set_defaults(fn="datanode_list")
+    rb = dn.add_parser("rebalance")
+    rb.add_argument("--factor", type=float, default=1.5)
+    rb.add_argument("--max-moves", type=int, default=2)
+    rb.set_defaults(fn="datanode_rebalance")
     dd = dn.add_parser("decommission")
     dd.add_argument("id", type=int)
     dd.set_defaults(fn="datanode_decommission")

@@ -195,6 +195,11 @@ class MasterDaemon(_Daemon):
         # (deadNodeSecs in config; tests compress it)
         self.dead_node_secs = float(cfg.get("deadNodeSecs",
                                             60 * HEARTBEAT_INTERVAL))
+        # hot-volume spreading: rebalanceHotSecs > 0 runs a rebalance_hot
+        # sweep on its own cadence (0/absent = off — the operator or the
+        # capacity harness triggers it via /dataNode/rebalanceHot instead)
+        self.rebalance_hot_secs = float(cfg.get("rebalanceHotSecs", 0))
+        self.rebalance_hot_factor = float(cfg.get("rebalanceHotFactor", 1.5))
         self.net = _make_net(self.node_id, raft_peers, cfg)
         self.raft = MultiRaft(self.node_id, self.net, wal_dir=cfg.get("walDir"),
                               snapshot_every=512)
@@ -223,6 +228,16 @@ class MasterDaemon(_Daemon):
         self.ticker.start()
         self._meta_handles: dict[int, object] = {}  # node_id -> RemoteMetaNode
         self._every(ENSURE_INTERVAL, self._ensure, f"master{self.node_id}-ensure")
+        if self.rebalance_hot_secs > 0:
+            self._every(self.rebalance_hot_secs, self._rebalance_hot,
+                        f"master{self.node_id}-rebalance")
+
+    def _rebalance_hot(self):
+        if self.master.is_leader:
+            moved = self.master.rebalance_hot(factor=self.rebalance_hot_factor)
+            if moved:
+                _log(f"master{self.node_id}",
+                     f"rebalance_hot moved {moved} replica(s)")
 
     # -- admin tasks to nodes (master/cluster_task.go analog) ------------------
 
@@ -612,11 +627,20 @@ class DataNodeDaemon(_Daemon):
         from chubaofs_tpu.master.master import MasterError
 
         pids = {pid: 0 for pid in list(self.datanode.space.partitions)}
+        loads = self.datanode.take_loads()
         try:
             self.mc.heartbeat(self.node_id, partitions=len(pids), cursors=pids,
-                              **_space_report(self.data_dir))
+                              loads=loads, **_space_report(self.data_dir))
         except MasterError:
+            # the master lost this node's record ("unknown node"): the
+            # report never landed, so fold the consumed window back in
+            self.datanode.refund_loads(loads)
             self._register()
+        except Exception:
+            # same for transport failures: a master hiccup must not erase
+            # an observed load window
+            self.datanode.refund_loads(loads)
+            raise
         _resolve_raft_peers(self.mc, self.net)
 
     def stop(self):

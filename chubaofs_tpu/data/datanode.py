@@ -204,6 +204,12 @@ class DataNode:
         self.repair_lanes = repair_lanes
         self._repair_sem = threading.BoundedSemaphore(repair_lanes)
         self._reg = registry("datanode")  # bound once: dispatch is per-packet
+        # per-partition op tally since the last take_loads() — the heartbeat
+        # payload the master's hot-volume rebalancer reads. A plain dict on
+        # purpose: partition ids are unbounded, so this must never become a
+        # metric label (obslint rule 1); the aggregate ops ride the `op` TP.
+        self._loads_lock = SanitizedLock(name="datanode.loads")
+        self._op_loads: dict[int, int] = {}
         self.server = ReplServer(addr, self._dispatch)
         self.space.load_all(raft)
 
@@ -217,6 +223,21 @@ class DataNode:
     def stop(self) -> None:
         self.server.stop()
 
+    def take_loads(self) -> dict[int, int]:
+        """Per-partition ops served since the last call, then reset — each
+        heartbeat reports one window's delta, so the master's NodeInfo.loads
+        is always a recent-load snapshot, not a lifetime total."""
+        with self._loads_lock:
+            out, self._op_loads = self._op_loads, {}
+        return out
+
+    def refund_loads(self, loads: dict[int, int]) -> None:
+        """Fold a taken-but-unreported window back in (heartbeat send
+        failed) so a transient master hiccup never erases observed load."""
+        with self._loads_lock:
+            for pid, c in loads.items():
+                self._op_loads[pid] = self._op_loads.get(pid, 0) + c
+
     # -- dispatch (wrap_operator.go:80 analog) ---------------------------------
 
     def _dispatch(self, pkt: Packet) -> Packet:
@@ -227,6 +248,12 @@ class DataNode:
         at flush don't flood the caller's bounded track log), and slow-op
         audit over CFS_SLOWOP_MS."""
         name = op_name(pkt.opcode)
+        if pkt.partition_id and pkt.opcode not in self.REPAIR_CLASS:
+            # client-class IO only: repair/migrate streams are the cure, and
+            # counting them would make the rebalancer chase its own moves
+            with self._loads_lock:
+                self._op_loads[pkt.partition_id] = \
+                    self._op_loads.get(pkt.partition_id, 0) + 1
         traced = isinstance(pkt.arg, dict) and TRACE_ARG_KEY in pkt.arg
         span = trace_extract(pkt, f"datanode.{name}")
         trace.push_span(span)

@@ -85,6 +85,7 @@ class MasterAPI:
         g("/node/heartbeat", self._w(self.node_heartbeat, admin=True, cap="node"))
         g("/dataNode/decommission", self._w(self.decommission_data, admin=True))
         g("/metaNode/decommission", self._w(self.decommission_meta, admin=True))
+        g("/dataNode/rebalanceHot", self._w(self.rebalance_hot, admin=True))
         g("/user/create", self._w(self.user_create, admin=True))
         g("/user/delete", self._w(self.user_delete, admin=True))
         g("/user/info", self._w(self.user_info, leader=False))
@@ -313,13 +314,15 @@ class MasterAPI:
         # "{}" = an explicit empty report that WIPES the node's cursor set
         raw = req.q("cursors", "")
         cursors = json.loads(raw) if raw else None
+        raw_loads = req.q("loads", "")
         total = req.q("total_space", "")
         used = req.q("used_space", "")
         self.master.heartbeat(int(req.q("id")),
                               partition_count=int(req.q("partitions", "0")),
                               cursors=cursors,
                               total_space=int(total) if total else None,
-                              used_space=int(used) if used else None)
+                              used_space=int(used) if used else None,
+                              loads=json.loads(raw_loads) if raw_loads else None)
         return None
 
     def decommission_meta(self, req: Request):
@@ -327,6 +330,16 @@ class MasterAPI:
 
     def decommission_data(self, req: Request):
         return {"migrated": self.master.decommission_datanode(int(req.q("id")))}
+
+    def rebalance_hot(self, req: Request):
+        """One hot-volume spreading sweep (the capacity harness's knob);
+        returns the moves made plus the per-node load view it acted on."""
+        moved = self.master.rebalance_hot(
+            factor=float(req.q("factor", "1.5")),
+            max_moves=int(req.q("maxMoves", "2")))
+        return {"moved": moved,
+                "loads": {str(k): v
+                          for k, v in self.master.data_node_loads().items()}}
 
     @staticmethod
     def _user_view(u) -> dict:
@@ -529,13 +542,19 @@ class MasterClient:
     def heartbeat(self, node_id: int, partitions: int = 0,
                   cursors: dict | None = None,
                   total_space: int | None = None,
-                  used_space: int | None = None):
+                  used_space: int | None = None,
+                  loads: dict | None = None):
         import json
 
         return self.call(self._path(
             "/node/heartbeat", id=node_id, partitions=partitions,
             cursors=None if cursors is None else json.dumps(cursors),
-            total_space=total_space, used_space=used_space))
+            total_space=total_space, used_space=used_space,
+            loads=None if loads is None else json.dumps(loads)))
+
+    def rebalance_hot(self, factor: float = 1.5, max_moves: int = 2):
+        return self.call(self._path("/dataNode/rebalanceHot", factor=factor,
+                                    maxMoves=max_moves))
 
     def cluster_stat(self):
         return self.call("/admin/getClusterStat")

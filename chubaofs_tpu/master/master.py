@@ -46,6 +46,9 @@ class NodeInfo:
     nodeset: int = 0  # zone-local nodeset index (bounded failure groups)
     total_space: int = 0  # bytes, node-reported via heartbeat (statinfo)
     used_space: int = 0
+    # pid -> ops served in the node's last heartbeat window (datanode
+    # take_loads() delta) — the hot-volume rebalancer's accounting feed
+    loads: dict[int, float] = field(default_factory=dict)
 
     @property
     def schedulable(self) -> bool:
@@ -155,6 +158,9 @@ class MasterSM(StateMachine):
         def load_nodes(batch):
             for d in batch:
                 d["cursors"] = {int(k): v for k, v in d["cursors"].items()}
+                # .get: snapshots from before load accounting existed
+                d["loads"] = {int(k): float(v)
+                              for k, v in d.get("loads", {}).items()}
                 n = NodeInfo(**d)
                 self.nodes[n.node_id] = n
 
@@ -251,7 +257,8 @@ class MasterSM(StateMachine):
     def _op_heartbeat(self, node_id: int, partition_count: int = 0,
                       cursors: dict | None = None, now: float = 0.0,
                       total_space: int | None = None,
-                      used_space: int | None = None):
+                      used_space: int | None = None,
+                      loads: dict | None = None):
         n = self.nodes.get(node_id)
         if n is None:
             raise MasterError(f"unknown node {node_id}")
@@ -270,6 +277,9 @@ class MasterSM(StateMachine):
         # create tasks); None means "no report" and leaves state alone
         if cursors is not None:
             n.cursors = {int(k): v for k, v in cursors.items()}
+        # per-partition op-load window (same replace-vs-no-report contract)
+        if loads is not None:
+            n.loads = {int(k): float(v) for k, v in loads.items()}
         return None
 
     def _op_create_volume(self, name: str, owner: str, capacity: int, cold: bool,
@@ -550,14 +560,16 @@ class Master:
     def heartbeat(self, node_id: int, partition_count: int = 0,
                   cursors: dict | None = None,
                   total_space: int | None = None,
-                  used_space: int | None = None):
+                  used_space: int | None = None,
+                  loads: dict | None = None):
         # a returning node may receive new placements again, so the dead-node
         # sweep must re-examine it if it dies a second time
         with self._drained_lock:
             self._dead_drained.discard(node_id)
         self._apply("heartbeat", node_id=node_id, partition_count=partition_count,
                     cursors=cursors, now=time.time(),
-                    total_space=total_space, used_space=used_space)
+                    total_space=total_space, used_space=used_space,
+                    loads=loads)
 
     def cluster_stat(self) -> dict:
         """Cluster/zone space + health rollup from node heartbeat reports.
@@ -861,13 +873,17 @@ class Master:
             return self._migrate_datanode(node_id)
 
     def _move_dp_replica(self, vol, dp, node_id: int,
-                         prefer_zone: str | None = None) -> None:
+                         prefer_zone: str | None = None,
+                         repl: NodeInfo | None = None) -> None:
         """Move one dp replica off node_id (decommission, dead-node re-home,
-        and spread-repair all share this step)."""
-        repl = self._pick_addition(
-            "data", [p for p in dp.peers if p != node_id],
-            exclude={node_id},
-            prefer_zone=prefer_zone)
+        spread-repair and hot-volume rebalance all share this step). An
+        explicit `repl` (the rebalancer's load-ranked pick) skips the
+        zone/domain-ranked _pick_addition."""
+        if repl is None:
+            repl = self._pick_addition(
+                "data", [p for p in dp.peers if p != node_id],
+                exclude={node_id},
+                prefer_zone=prefer_zone)
         idx = dp.peers.index(node_id)
         new_peers = [p for p in dp.peers if p != node_id] + [repl.node_id]
         hosts = self._current_hosts(dp.peers, dp.hosts)
@@ -941,6 +957,89 @@ class Master:
                 except MasterError:
                     pass  # no capacity after all; retried next sweep
         return moved
+
+    # -- hot-volume spreading (the capacity harness's actuator) -----------------
+
+    def data_node_loads(self) -> dict[int, float]:
+        """node_id -> total ops in the last heartbeat window, schedulable
+        datanodes only — the per-node ops-spread view cfs-capacity's A/B
+        measures (and rebalance_hot acts on)."""
+        return {n.node_id: sum(n.loads.values())
+                for n in self.sm.nodes.values()
+                if n.kind == "data" and n.schedulable}
+
+    def _find_dp(self, pid: int):
+        for vol in self.sm.volumes.values():
+            for dp in vol.data_partitions:
+                if dp.partition_id == pid:
+                    return vol, dp
+        return None, None
+
+    def rebalance_hot(self, factor: float = 1.5, max_moves: int = 2) -> int:
+        """Hot-volume spreading under skewed load: any schedulable datanode
+        whose heartbeat-window op load exceeds `factor` x the mean sheds its
+        hottest data-partition replicas onto the coldest nodes not already
+        hosting them, through the same create->raft-add->raft-remove->drop
+        migration dance decommission uses (_move_dp_replica). Zipfian access
+        concentrates leaders; this is the knob that actually fixes the
+        hotspots the capacity harness finds. A move must strictly improve
+        the pair (target load + partition load < source load) or it is
+        skipped — the sweep converges instead of ping-ponging replicas.
+        Bounded at `max_moves` per sweep so rebalancing traffic (replica
+        catch-up rides the repair path) never dominates foreground IO.
+        Domain concentration a load-ranked pick may introduce is healed by
+        check_replica_spread, the same residue contract re-homing has."""
+        if not self.is_leader:
+            return 0
+        with self._decomm_lock:
+            datas = {n.node_id: n for n in self.sm.nodes.values()
+                     if n.kind == "data" and n.schedulable}
+            if len(datas) < 2:
+                return 0
+            # local bookkeeping copy: replicated NodeInfo.loads must only
+            # mutate inside raft apply, but the sweep still needs to account
+            # its own moves so one pass doesn't dogpile a single cold node
+            loads = {nid: sum(n.loads.values()) for nid, n in datas.items()}
+            total = sum(loads.values())
+            if total <= 0:
+                return 0
+            mean = total / len(loads)
+            moved = 0
+            for nid in sorted(loads, key=loads.get, reverse=True):
+                if moved >= max_moves:
+                    break
+                # snapshot ONCE: the raft apply thread REPLACES n.loads on
+                # every heartbeat, and a double attribute read (iterable +
+                # key fn) could straddle the swap — .get(old_pid) -> None
+                # would crash the sort mid-sweep
+                pid_loads = dict(datas[nid].loads)
+                for pid in sorted(pid_loads, key=pid_loads.get, reverse=True):
+                    if loads[nid] <= factor * mean:
+                        break  # shed enough; next hot node
+                    pid_load = pid_loads.get(pid, 0.0)
+                    if pid_load <= 0:
+                        break
+                    vol, dp = self._find_dp(pid)
+                    if dp is None or nid not in dp.peers:
+                        continue  # meta pid, or a replica already moved
+                    cands = [n for n in datas.values()
+                             if n.node_id not in dp.peers]
+                    if not cands:
+                        continue
+                    target = min(cands, key=lambda n: (loads[n.node_id],
+                                                       n.partition_count))
+                    if loads[target.node_id] + pid_load >= loads[nid]:
+                        continue  # would not strictly improve the pair
+                    try:
+                        self._move_dp_replica(vol, dp, nid, repl=target)
+                    except MasterError:
+                        continue  # no capacity after all; retried next sweep
+                    loads[nid] -= pid_load
+                    loads[target.node_id] += pid_load
+                    moved += 1
+                    if moved >= max_moves:
+                        break
+            return moved
 
     # -- background checks (scheduleTask loop analogs) --------------------------
 

@@ -170,6 +170,59 @@ class ProcCluster:
                 p.kill()
         self.procs.clear()
 
+    # -- boot-line introspection ----------------------------------------------
+
+    def boot_info(self, name: str, timeout: float = 60.0) -> dict:
+        """The daemon's boot JSON line, parsed off its captured stdout log
+        (cmd.main prints it as the stdout protocol). This is how a harness
+        learns ephemeral side-door ports (statsListen's /metrics address).
+        stderr shares the log file, so scan for the first line that parses
+        as the boot record rather than trusting line one."""
+        path = os.path.join(self.root, f"{name}.log")
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            try:
+                with open(path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line.startswith("{"):
+                            continue
+                        try:
+                            rec = json.loads(line)
+                        except ValueError:
+                            continue
+                        if isinstance(rec, dict) and "role" in rec:
+                            return rec
+            except OSError:
+                pass
+            time.sleep(0.1)
+        raise TimeoutError(f"{name} printed no boot line")
+
+    def stats_addrs(self, timeout: float = 60.0) -> list[str]:
+        """Every running metanode/datanode's /metrics side-door address —
+        the extra scrape targets a console rollup needs beyond the masters
+        and the blobstore gateway."""
+        out = []
+        for name in list(self.procs):
+            if not name.startswith(("metanode", "datanode")):
+                continue
+            addr = self.boot_info(name, timeout=timeout).get("stats_addr")
+            if addr:
+                out.append(addr)
+        return out
+
+    def spawn_console(self, metrics_addrs: list[str] | None = None,
+                      timeout: float = 60.0) -> str:
+        """Spawn a console daemon over this cluster's masters (plus any
+        extra /metrics targets) and return its address once it listens."""
+        addr = f"127.0.0.1:{free_port()}"
+        self.spawn("console", {
+            "role": "console", "masterAddrs": self.master_addrs,
+            "listen": addr, "metricsAddrs": list(metrics_addrs or []),
+        })
+        self._await_listen(addr, timeout=timeout)
+        return addr
+
     # -- cluster waiting -------------------------------------------------------
 
     def client_master(self):

@@ -23,6 +23,11 @@ one row per daemon target:
 scripts; without it the terminal refreshes in place until ^C. `--addr`
 (repeatable) skips the console and polls daemons' `/health` + `/metrics`
 directly. `--json` emits the frame as JSON instead of the table.
+
+`--frames N --out path` is the archival mode (the capacity-report consumer,
+cfs-capacity rides the same record shape): each frame is APPENDED to `path`
+as one JSON line stamped with a run-relative monotonic `t`, and the process
+exits after N frames — `cfs-top --json` alone only prints to a terminal.
 """
 
 from __future__ import annotations
@@ -217,6 +222,17 @@ def render(rows: list[dict], errors: list[str] = ()) -> str:
     return "\n".join(lines)
 
 
+# -- archival ------------------------------------------------------------------
+
+
+def frame_record(t0: float, frame: dict, rows: list[dict]) -> dict:
+    """One JSONL archive record: run-relative monotonic stamp + the computed
+    rows. Monotonic (not wall) so frame spacing survives NTP steps; run-
+    relative so two archives of the same scenario diff cleanly."""
+    return {"t": round(frame["mono"] - t0, 3), "rows": rows,
+            "errors": list(frame.get("errors", ()))}
+
+
 # -- CLI -----------------------------------------------------------------------
 
 
@@ -236,18 +252,40 @@ def main(argv=None, out=None) -> int:
     p.add_argument("--once", action="store_true",
                    help="render one frame and exit (CI mode)")
     p.add_argument("--json", action="store_true")
+    p.add_argument("--frames", type=int, default=0,
+                   help="archive N frames then exit (requires --out)")
+    p.add_argument("--out", default="",
+                   help="append frames as JSONL records to this path")
     args = p.parse_args(argv)
     if not args.console and not args.addr:
         p.error("give --console or --addr")
+    if bool(args.frames) != bool(args.out):
+        p.error("--frames and --out go together")
+    if args.out:
+        # archival is its own mode: a stray --once would truncate the
+        # archive to 1 frame with exit 0, and --json would be silently
+        # ignored — both are operator mistakes worth failing loudly on
+        if args.frames < 1:
+            p.error("--frames must be >= 1")
+        if args.once or args.json:
+            p.error("--out is the archival mode; drop --once/--json")
 
     interval = max(0.1, args.interval)
     prev = fetch_frame(args.console, args.addr)
+    t0 = prev["mono"]
+    archived = 0
     try:
         while True:
             time.sleep(interval)
             cur = fetch_frame(args.console, args.addr)
             rows = compute_rows(prev, cur)
-            if args.json:
+            if args.out:
+                with open(args.out, "a", encoding="utf-8") as f:
+                    f.write(json.dumps(frame_record(t0, cur, rows)) + "\n")
+                archived += 1
+                if archived >= args.frames:
+                    return 0
+            elif args.json:
                 print(json.dumps({"rows": rows, "errors": cur["errors"]},
                                  indent=2), file=out)
             else:

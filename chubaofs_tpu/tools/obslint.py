@@ -4,7 +4,11 @@
    id (inode, blob id, volume id, extent id, request/trace id, path, upload
    id) explodes the registry: every distinct value mints a fresh time series,
    and one busy volume turns /metrics into a memory leak. Label sets must be
-   bounded by construction (op names, reasons, disk kinds).
+   bounded by construction (op names, reasons, disk kinds). Labels whose
+   values are configured identities rather than literals — `tenant` in the
+   capacity harness — are bounded at RUNTIME instead: the subsystem declares
+   the closed set via `exporter.declare_label_values`, and any undeclared
+   value is rejected at the metric call (the runtime half of this rule).
 
 2. **No new ad-hoc stats dicts.** Counters live in `exporter.Registry` (role
    registries), where they are locked, rendered, and scrape-able — not in

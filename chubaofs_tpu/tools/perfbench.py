@@ -621,6 +621,89 @@ def bench_concurrency(clients_axis: tuple = (64, 256, 1024),
     return out
 
 
+def bench_capacity(root: str, duration: float = 3.5, rate: float = 20.0,
+                   seed: int = 7, interval: float = 0.4,
+                   tenants: int = 3) -> dict:
+    """Capacity-harness smoke (ISSUE 11): the cfs-capacity generator /
+    collector / gate loop at seconds scale over an IN-PROCESS FsCluster
+    (whose access/codec registries this process's /health evaluates),
+    fronted by a real RPCServer + console so the collector exercises the
+    same `/api/health` + `/api/metrics` rollup path as a daemon cluster.
+
+    Two phases on the same seed: a CLEAN run (the gate must evaluate to a
+    non-None, non-failing verdict and archive >=3 JSONL frames) and a CHAOS
+    run (a sustained `blobnode.put_shard` delay under a tightened PUT p99
+    objective must flip the verdict to failing, naming put_p99) — the
+    regression pair that keeps the gate honest in both directions."""
+    from chubaofs_tpu import chaos
+    from chubaofs_tpu.console.server import Console
+    from chubaofs_tpu.deploy import FsCluster
+    from chubaofs_tpu.rpc.router import Router
+    from chubaofs_tpu.rpc.server import RPCServer
+    from chubaofs_tpu.tools.capacity import (
+        Collector, LocalDriver, Workload, plan_ops)
+    from chubaofs_tpu.utils import metrichist
+
+    out: dict = {}
+    c = FsCluster(os.path.join(root, "cap"), n_nodes=3, blob_nodes=6,
+                  data_nodes=0)
+    srv = RPCServer(Router(), module="capacity").start()
+    console = Console([srv.addr])
+
+    def phase(report: str) -> tuple[dict, dict]:
+        plan = plan_ops(seed, tenants, duration, rate, 1.2,
+                        keys_per_tenant=32, ramp="diurnal")
+        wl = Workload(LocalDriver(c, "capvol"), plan, seed=seed, workers=4)
+        col = Collector(report, console=console.addr, interval=interval)
+        col.start()
+        try:
+            ledger = wl.run()
+            time.sleep(2 * interval)  # the tail burn windows land
+        finally:
+            col.stop()
+            wl.close()
+        return col.verdict(), ledger
+
+    prev_slo = os.environ.get("CFS_SLO_PUT_P99_MS")
+    try:
+        c.create_volume("capvol", cold=True)
+        c.blobstore.access.put(b"warm" * 256)  # jit outside the window
+        verdict, ledger = phase(os.path.join(root, "capacity-clean.jsonl"))
+        out["cap_frames_clean"] = verdict["frames"]
+        out["cap_verdict_clean"] = verdict["verdict"]
+        out["cap_ops_ok"] = ledger["ops_ok"]
+        out["cap_ops_planned"] = ledger["ops_planned"]
+        out["cap_corruptions"] = len(ledger["corruptions"])
+        out["cap_max_late_s"] = ledger["max_late_s"]
+        log(f"  capacity clean: verdict={verdict['verdict']} "
+            f"frames={verdict['frames']} ops_ok={ledger['ops_ok']}"
+            f"/{ledger['ops_planned']}")
+        # chaos phase: sustained shard-write latency + a 20ms objective
+        os.environ["CFS_SLO_PUT_P99_MS"] = "20"
+        chaos.arm("blobnode.put_shard", "delay(0.03)")
+        try:
+            verdict2, _ = phase(os.path.join(root, "capacity-chaos.jsonl"))
+        finally:
+            chaos.disarm("blobnode.put_shard")
+        out["cap_verdict_chaos"] = verdict2["verdict"]
+        out["cap_chaos_flipped"] = sorted(
+            {n for names in verdict2["flipped"].values() for n in names})
+        log(f"  capacity chaos: verdict={verdict2['verdict']} "
+            f"flipped={out['cap_chaos_flipped']}")
+    finally:
+        if prev_slo is None:
+            os.environ.pop("CFS_SLO_PUT_P99_MS", None)
+        else:
+            os.environ["CFS_SLO_PUT_P99_MS"] = prev_slo
+        console.stop()
+        srv.stop()
+        c.close()
+        # the chaos phase salted the default history ring with slow-put
+        # snapshots; drop it so later /health consumers start clean
+        metrichist.deactivate()
+    return out
+
+
 def run(root: str, n_files: int = 600, n_clients: int = 4,
         stream_mb: int = 64, metanodes: int = 3, datanodes: int = 3) -> dict:
     from chubaofs_tpu.testing.harness import ProcCluster
@@ -633,6 +716,8 @@ def run(root: str, n_files: int = 600, n_clients: int = 4,
                                   n_puts=max(3, min(8, n_files // 100))))
     log("repair plane (windowed rebuild vs serial control)...")
     cfg.update(bench_repair(os.path.join(root, "repairbench")))
+    log("capacity harness (SLO gate smoke, clean + chaos)...")
+    cfg.update(bench_capacity(os.path.join(root, "capbench")))
 
     cluster = ProcCluster(root, masters=1, metanodes=metanodes,
                           datanodes=datanodes)

@@ -69,3 +69,29 @@ class Config:
         missing = [k for k in keys if not self._lookup(k)[1]]
         if missing:
             raise ConfigError(f"missing required config keys: {missing}")
+
+
+# -- env knob parsing (the CFS_* idiom shared by tools/daemons) ----------------
+#
+# The unclamped canonical pair: a malformed value degrades to the default
+# (these parses often run during daemon boot, where a typo'd env var must
+# not kill the process). Callers needing a floor (evloop's >=1 shard count,
+# slo's window sizes) keep their own clamped wrappers.
+
+
+def env_int(name: str, default: int) -> int:
+    import os
+
+    try:
+        return int(os.environ.get(name, "") or default)
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float) -> float:
+    import os
+
+    try:
+        return float(os.environ.get(name, "") or default)
+    except ValueError:
+        return default

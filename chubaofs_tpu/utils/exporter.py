@@ -37,6 +37,43 @@ def _key(name: str, labels: dict[str, str] | None) -> tuple:
     return (name, tuple(sorted((labels or {}).items())))
 
 
+# -- bounded label values (the runtime half of obslint rule 1) -----------------
+#
+# A label like `tenant` is legitimate ONLY while its value set is closed: one
+# request-derived string per series turns /metrics into a memory leak. A
+# subsystem that mints per-tenant families declares the closed set up front
+# (declare_label_values); any metric call carrying that key with an
+# undeclared value then fails loudly instead of silently growing the registry.
+
+_BOUNDED_LABELS: dict[str, frozenset] = {}
+_bounded_lock = threading.Lock()
+
+
+def declare_label_values(key: str, values) -> None:
+    """Register the closed value set for a label key (e.g. the configured
+    tenant ids). Re-declaring replaces the set; `values=None` removes the
+    restriction (test teardown)."""
+    with _bounded_lock:
+        if values is None:
+            _BOUNDED_LABELS.pop(key, None)
+        else:
+            _BOUNDED_LABELS[key] = frozenset(str(v) for v in values)
+
+
+def _check_bounded(labels: dict | None) -> None:
+    if not labels or not _BOUNDED_LABELS:
+        return  # the common daemon: nothing declared, zero overhead
+    for k, v in labels.items():
+        allowed = _BOUNDED_LABELS.get(k)
+        if allowed is not None and str(v) not in allowed:
+            raise ValueError(
+                f"label {k}={v!r} is outside its declared bounded set "
+                f"({len(allowed)} values) — an unbounded {k} string would "
+                "mint a fresh series per value (high-cardinality guard); "
+                "declare it via exporter.declare_label_values or use a "
+                "bounded id")
+
+
 class Counter:
     __slots__ = ("value", "_lock")
 
@@ -142,6 +179,7 @@ class Registry:
         self.consul_registration: dict | None = None
 
     def _get(self, kind: str, name: str, labels, factory):
+        _check_bounded(labels)
         k = _key(name, labels)
         with self._lock:
             have = self._kinds.get(name)

@@ -155,11 +155,10 @@ def _env_sample() -> float:
 def _env_int(name: str, default: int) -> int:
     """Malformed byte/file budgets degrade to defaults — this parse runs
     inside RPCServer construction (activate_from_env), where a typo'd env
-    var must not kill daemon boot."""
-    try:
-        return int(os.environ.get(name, "") or default)
-    except ValueError:
-        return default
+    var must not kill daemon boot. Canonical impl: utils.config.env_int."""
+    from chubaofs_tpu.utils.config import env_int
+
+    return env_int(name, default)
 
 
 def default_sink() -> TraceSink:
