@@ -167,10 +167,15 @@ class Access:
         read_deadline: float = 3.0,
         punish_secs: float = 30.0,
         qos=None,
+        cache=None,
     ):
         self.cm = cm
         self.proxy = proxy
         self.nodes = nodes
+        # optional blobstore.cache.BlobCache (ISSUE 12): zipfian GET traffic
+        # serves its hot head from here instead of an EC shard gather per
+        # read; None keeps the pre-cache read path byte-identical
+        self.cache = cache
         self.codec = codec or default_service()
         self.secret = secret
         self.cluster_id = cluster_id
@@ -730,6 +735,63 @@ class Access:
         return bytes(out)
 
     def _read_blob(self, mode: int, blob: Blob, offset: int, size: int) -> bytes:
+        """Tiered read: cache -> hot Replica3 copy -> EC cold path. Every
+        lookup feeds the cache's heat accounting; blobs that cross the
+        promote threshold are reported to the hot-blob topic, where the
+        scheduler's tier sweep copies them into the replica engine."""
+        cache = self.cache
+        full = offset == 0 and size == blob.size
+        fill_ver = None
+        if cache is not None:
+            cached = cache.get(blob.vid, blob.bid, offset, size)
+            if cache.promote_signal(blob.vid, blob.bid):
+                try:
+                    self.proxy.send_blob_hot(blob.vid, blob.bid, blob.size)
+                except Exception:
+                    pass  # advisory: lost heat re-accumulates next epoch
+            if cached is not None and len(cached) == size:
+                return bytes(cached)
+            if full:
+                # version captured BEFORE the backend read: a DELETE racing
+                # this miss invalidates the version and the fill is dropped
+                fill_ver = cache.fill_version(blob.vid, blob.bid)
+        hot = self.cm.hot_location(blob.vid, blob.bid)
+        if hot is not None:
+            data = self._read_blob_hot(hot, offset, size)
+            if data is not None:
+                if fill_ver is not None:
+                    cache.fill(blob.vid, blob.bid, fill_ver, data)
+                return data
+        data = self._read_blob_ec(mode, blob, offset, size)
+        if fill_ver is not None:
+            cache.fill(blob.vid, blob.bid, fill_ver, data)
+        return data
+
+    def _read_blob_hot(self, hot: tuple[int, int], offset: int,
+                       size: int) -> bytes | None:
+        """One direct read of the Replica3 copy's data shard (shard 0 IS the
+        blob bytes — systematic RS(1,2), exact-size shards). Any failure
+        falls back to the authoritative EC copy: the hot tier accelerates,
+        it never gates availability."""
+        hot_vid, hot_bid = hot
+        reg = registry("cache")
+        try:
+            vol = self.cm.get_volume(hot_vid)
+            unit = vol.units[0]
+            node = self.nodes.get(unit.node_id)
+            if node is None:
+                raise ConnectionError(f"hot node {unit.node_id} unknown")
+            chaos.failpoint("access.read_shard", node=unit.node_id)
+            data = node.get_shard(unit.vuid, hot_bid, offset=offset, size=size)
+            if len(data) != size:
+                raise AccessError("short hot read")
+        except Exception:
+            reg.counter("tier_fallbacks").add()
+            return None
+        reg.counter("tier_hits").add()
+        return bytes(data)
+
+    def _read_blob_ec(self, mode: int, blob: Blob, offset: int, size: int) -> bytes:
         t = get_tactic(mode)
         vol = self.cm.get_volume(blob.vid)
         shard_len = t.shard_size(blob.size)
@@ -1022,6 +1084,12 @@ class Access:
             loc = Location.from_json(loc)
         self._check_sig(loc)
         for blob in loc.blobs:
+            # write-through punch-out BEFORE the async delete fans out: once
+            # invalidate returns (however long a chaos failpoint stretches
+            # it), no cached copy is reachable — so by the time the deleter
+            # punches shards, a GET can only see the backend's truth
+            if self.cache is not None:
+                self.cache.invalidate(blob.vid, blob.bid)
             self.proxy.send_blob_delete(blob.vid, blob.bid)
 
     def close(self) -> None:

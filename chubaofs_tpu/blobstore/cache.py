@@ -1,0 +1,235 @@
+"""BlobCache — the blobstore read-cache plane (ISSUE 12 tentpole).
+
+Reference counterpart: blockcache/ + preload/ (PAPER.md layer map) — the
+reference puts a node-local cache daemon and a preload pipeline in front of
+the erasure-coded cold path because million-user GET traffic is zipfian: a
+small hot head absorbs most reads, and serving it from an EC shard gather +
+device decode per read is the online-EC read penalty arxiv 1709.05365
+measures. Here the cache is in-process with the access gateway (the SDK and
+S3 objectnode both read through `Access`, so one cache covers both GET
+surfaces) and rides the grown `blockcache.BcacheManager`: TinyLFU admission
+(counting sketch + ghost list) in front of a two-tier (memory overlay +
+disk file) LRU with separate byte budgets.
+
+Correctness contract — entries are keyed `(vid, bid, version)`:
+
+  * blobs are immutable per bid on the write path (an overwrite allocates
+    fresh bids), so a hit can only go stale through DELETE punch-out or a
+    tier rewrite — both call `invalidate(vid, bid)`, which evicts the bytes
+    AND bumps the blob's version;
+  * `fill()` captures the version BEFORE the backend read and commits only
+    if it still matches — a fill racing an invalidation lands under a dead
+    version (unreachable) instead of resurrecting punched bytes;
+  * the `cache.invalidate` failpoint sits in front of the punch-out so
+    chaos runs can delay it and prove read-after-overwrite/-delete stays
+    byte-correct (tests/test_cache_plane.py, chaos/soak.run_cache_soak).
+
+Heat accounting for tier promotion also lives here: every lookup feeds a
+bounded per-(vid, bid) counter, and `promote_signal()` fires once per blob
+per aging epoch when CFS_PROMOTE_HITS accesses accumulate — the access
+layer forwards the signal to the proxy's hot-blob topic, where the
+scheduler turns it into a lease-driven promote task.
+
+Knobs: CFS_CACHE_MB (memory-tier budget; 0/unset = cache plane off),
+CFS_CACHE_DISK_MB (disk-tier budget, default 4x memory),
+CFS_CACHE_ADMIT ("tinylfu" | "always"), CFS_PROMOTE_HITS (promotion
+threshold, 0 = never signal).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from collections import OrderedDict
+
+from chubaofs_tpu import chaos
+from chubaofs_tpu.blockcache.bcache import BcacheManager
+from chubaofs_tpu.utils.exporter import declare_label_values, registry
+from chubaofs_tpu.utils.locks import SanitizedLock
+
+# version-map bound: past _VER_MAX entries the prune pops oldest-first,
+# but never an entry younger than the minimum-age floor — comfortably
+# above any backend read's lifetime (write_deadline is 10s), so the
+# fill-race window the version map exists to close stays closed. The map
+# is then bounded by _VER_MIN_AGE_S worth of delete churn.
+_VER_MAX = 65536
+_VER_MIN_AGE_S = 30.0
+
+# heat table bound: zipfian traffic concentrates heat, so a few thousand
+# slots hold every plausible promotion candidate; on overflow the coldest
+# half of the table is dropped (never the hot head)
+_HEAT_MAX = 4096
+
+
+class BlobCache:
+    """In-process read cache for blobstore blobs, keyed (vid, bid, version)."""
+
+    def __init__(self, cache_dir: str, mem_mb: int | None = None,
+                 disk_mb: int | None = None, admit: str | None = None,
+                 promote_hits: int | None = None):
+        if mem_mb is None:
+            mem_mb = int(os.environ.get("CFS_CACHE_MB", "") or 64)
+        if disk_mb is None:
+            disk_mb = int(os.environ.get("CFS_CACHE_DISK_MB", "") or 0)
+            if disk_mb <= 0:
+                disk_mb = 4 * mem_mb
+        if admit is None:
+            admit = os.environ.get("CFS_CACHE_ADMIT", "tinylfu")
+        if promote_hits is None:
+            promote_hits = int(os.environ.get("CFS_PROMOTE_HITS", "32") or 32)
+        self.promote_hits = promote_hits
+        self.mgr = BcacheManager(cache_dir, capacity_bytes=disk_mb << 20,
+                                 mem_capacity_bytes=mem_mb << 20,
+                                 admit=admit)
+        self._lock = SanitizedLock(name="cache.ver")
+        # (vid, bid) -> (version, monotonic stamp of the bump), kept in
+        # bump order (move_to_end on re-bump) so pruning pops oldest-first
+        # without ever sorting under the lock every GET also takes
+        self._ver: OrderedDict[tuple[int, int], tuple[int, float]] = \
+            OrderedDict()
+        # (vid, bid) -> access count since the last signal/aging/invalidate
+        self._heat: dict[tuple[int, int], int] = {}
+        self._heat_total = 0
+        self._reg = registry("cache")
+        declare_label_values("tier", ("mem", "disk"))
+
+    @classmethod
+    def from_env(cls, cache_dir: str) -> "BlobCache | None":
+        """The deployment wiring: a cache only when CFS_CACHE_MB is set to a
+        positive budget — unset keeps every existing path byte-identical."""
+        try:
+            mem_mb = int(os.environ.get("CFS_CACHE_MB", "0") or 0)
+        except ValueError:
+            mem_mb = 0
+        if mem_mb <= 0:
+            return None
+        return cls(cache_dir, mem_mb=mem_mb)
+
+    # -- keying ----------------------------------------------------------------
+
+    def _version(self, vid: int, bid: int) -> int:
+        ver = self._ver.get((vid, bid))
+        return 0 if ver is None else ver[0]
+
+    @staticmethod
+    def _key(vid: int, bid: int, ver: int) -> str:
+        return f"b_{vid}_{bid}_{ver}"
+
+    # -- read path -------------------------------------------------------------
+
+    def get(self, vid: int, bid: int, offset: int = 0,
+            size: int | None = None) -> bytes | None:
+        """Ranged lookup; every call (hit or miss) is a heat sample."""
+        self._reg.counter("lookups").add()
+        with self._lock:
+            ver = self._version(vid, bid)
+            self._note_heat_locked(vid, bid)
+        data = self.mgr.get(self._key(vid, bid, ver), offset, size)
+        # hit/miss tallies ride the manager's cfs_bcache_* counters too;
+        # cfs_cache_* is the plane-level family SLOs and cfs-top consume
+        if data is None:
+            self._reg.counter("misses").add()
+        else:
+            self._reg.counter("hits").add()
+        return data
+
+    def fill_version(self, vid: int, bid: int) -> int:
+        """Capture the blob's version BEFORE reading the backend; pass it to
+        fill() so a fill whose backend read straddled an invalidation can
+        never land reachable bytes."""
+        with self._lock:
+            return self._version(vid, bid)
+
+    def fill(self, vid: int, bid: int, ver: int, data: bytes) -> bool:
+        with self._lock:
+            if ver != self._version(vid, bid):
+                self._reg.counter("stale_fills").add()
+                return False
+        ok = self.mgr.put(self._key(vid, bid, ver), data)
+        # re-check AFTER the store write: an invalidate that raced the put
+        # may have evicted this key before the bytes landed — its version
+        # bump happens-before its evict, so a still-matching version here
+        # proves the entry was not punched behind us, and a mismatch means
+        # we must take our own bytes back out (an eventual version-map
+        # prune would otherwise make them reachable again)
+        with self._lock:
+            landed_stale = ver != self._version(vid, bid)
+        if landed_stale:
+            self.mgr.evict(self._key(vid, bid, ver))
+            self._reg.counter("stale_fills").add()
+            return False
+        self._reg.counter("fills" if ok else "fill_rejects").add()
+        return ok
+
+    # -- invalidation (write-through punch-out) --------------------------------
+
+    def invalidate(self, vid: int, bid: int) -> None:
+        """Punch the blob out: evict its bytes and bump its version. Callers
+        invalidate BEFORE queueing the backend delete/punch, so by the time
+        shards disappear no cached copy is reachable — the failpoint lets
+        chaos stretch that window and prove the ordering carries it."""
+        chaos.failpoint("cache.invalidate")
+        with self._lock:
+            cur, _ = self._ver.get((vid, bid), (0, 0.0))
+            self._ver[(vid, bid)] = (cur + 1, time.monotonic())
+            self._ver.move_to_end((vid, bid))
+            self._heat.pop((vid, bid), None)
+            self._prune_vers_locked()
+        self.mgr.evict(self._key(vid, bid, cur))
+        self._reg.counter("invalidations").add()
+
+    def _prune_vers_locked(self) -> None:
+        """Bound the version map: entries whose bump is older than the
+        minimum-age floor can go — any fill that captured the pre-bump
+        version has long since landed (unreachable, or self-evicted by the
+        post-put re-check) or died, and the bytes were evicted at bump
+        time, so forgetting the version cannot resurrect anything."""
+        if len(self._ver) <= _VER_MAX:
+            return
+        # the map is in bump order, so the oldest entries sit at the front:
+        # pop from there down to the cap, stopping at the minimum-age floor
+        # (see _VER_MIN_AGE_S) — O(evicted), no scan or sort under the lock
+        # every GET's version read also takes. In a storm where even the
+        # front is younger than the floor the map temporarily exceeds the
+        # cap, bounded by _VER_MIN_AGE_S worth of delete churn.
+        floor = time.monotonic() - _VER_MIN_AGE_S
+        while len(self._ver) > _VER_MAX:
+            key, (_, ts) = next(iter(self._ver.items()))
+            if ts > floor:
+                break
+            del self._ver[key]
+
+    # -- heat / promotion signals ----------------------------------------------
+
+    def _note_heat_locked(self, vid: int, bid: int) -> None:
+        key = (vid, bid)
+        self._heat[key] = self._heat.get(key, 0) + 1
+        self._heat_total += 1
+        if len(self._heat) > _HEAT_MAX:
+            # keep the hot half; the dropped tail was never promotable
+            keep = sorted(self._heat.items(), key=lambda kv: -kv[1])
+            self._heat = dict(keep[: _HEAT_MAX // 2])
+        if self._heat_total >= 16 * _HEAT_MAX:
+            # aging: halve so the signal tracks SUSTAINED heat
+            self._heat = {k: v >> 1 for k, v in self._heat.items() if v > 1}
+            self._heat_total //= 2
+
+    def promote_signal(self, vid: int, bid: int) -> bool:
+        """True once per CFS_PROMOTE_HITS accesses (the counter resets on
+        signal) — the caller forwards it to the hot-blob topic. A blob that
+        STAYS hot keeps signalling every promote_hits accesses, which is
+        what keeps the scheduler's idle-sweep demoter from evicting a
+        still-hot blob out of the hot tier: signal silence really means
+        'fewer than promote_hits accesses per demote window'."""
+        if self.promote_hits <= 0:
+            return False
+        key = (vid, bid)
+        with self._lock:
+            if self._heat.get(key, 0) < self.promote_hits:
+                return False
+            self._heat[key] = 0
+        self._reg.counter("promote_signals").add()
+        return True
+
+    def stats(self) -> dict:
+        return self.mgr.stats()

@@ -28,13 +28,22 @@ class MiniCluster:
         azs: int = 1,
         persist_cm: bool = True,
         codec: CodecService | None = None,
+        cache: "BlobCache | None" = None,
     ):
         """codec: inject a shared/mesh-backed CodecService (e.g. one built
         with a jax Mesh so access PUT/GET and scheduler repair run their
-        device math dp/sp-sharded across every chip); default single-device."""
+        device math dp/sp-sharded across every chip); default single-device.
+        cache: inject a blobstore.cache.BlobCache for the tiered read plane;
+        default comes from the environment (CFS_CACHE_MB > 0), so daemon
+        deployments and the capacity harness opt in with one knob."""
+        from chubaofs_tpu.blobstore.cache import BlobCache
+
         self.root = root
         self._owns_codec = codec is None  # injected services outlive us
         self.codec = codec or CodecService()
+        if cache is None:
+            cache = BlobCache.from_env(os.path.join(root, "cache"))
+        self.cache = cache
         self.cm = ClusterMgr(os.path.join(root, "cm") if persist_cm else None)
         self.nodes: dict[int, BlobNode] = {}
         for n in range(1, n_nodes + 1):
@@ -46,8 +55,10 @@ class MiniCluster:
                 {"disk_id": disk_id, "node_id": n, "az": az}
                 for disk_id in node.disks])
         self.proxy = Proxy(self.cm, data_dir=os.path.join(root, "proxy"))
-        self.access = Access(self.cm, self.proxy, self.nodes, codec=self.codec)
-        self.scheduler = Scheduler(self.cm, self.proxy, self.nodes, codec=self.codec)
+        self.access = Access(self.cm, self.proxy, self.nodes, codec=self.codec,
+                             cache=self.cache)
+        self.scheduler = Scheduler(self.cm, self.proxy, self.nodes,
+                                   codec=self.codec, cache=self.cache)
         self.worker = RepairWorker(self.scheduler, self.nodes, codec=self.codec)
 
     def run_background_once(self) -> dict:
@@ -66,6 +77,7 @@ class MiniCluster:
         scrubbed = self.scheduler.run_scrub()
         inspected = self.scheduler.inspect_volumes()
         polled = self.scheduler.poll_repair_topic()
+        tier_msgs = self.scheduler.run_tier()
         disk_tasks = self.scheduler.check_disks()
         balance_task = self.scheduler.check_balance()
         ran = 0
@@ -83,6 +95,7 @@ class MiniCluster:
         return {
             "inspect_msgs": inspected,
             "repair_msgs": polled,
+            "tier_msgs": tier_msgs,
             "disk_tasks": len(disk_tasks),
             "balance_tasks": 1 if balance_task else 0,
             "tasks_ran": ran,

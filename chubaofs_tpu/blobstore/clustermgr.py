@@ -89,6 +89,12 @@ class ClusterMgr:
         self.scopes: dict[str, int] = {}
         self.services: dict[str, list[str]] = {}
         self.config: dict[str, str] = {}
+        # tier residency map (ISSUE 12): (vid, bid) -> (hot_vid, hot_bid)
+        # for blobs the promoter copied into the Replica3 hot engine. The
+        # ORIGINAL EC copy stays authoritative (Location tokens keep
+        # working); the map is a read-path redirect, replicated like every
+        # other mutation so a restarted gateway keeps serving hot reads.
+        self.tiermap: dict[tuple[int, int], tuple[int, int]] = {}
         # monotonic heartbeat observations, THIS process only (never
         # persisted — a wall-clock stamp would be meaningless arithmetic
         # across restarts, and expiry is a liveness judgment about what this
@@ -166,6 +172,8 @@ class ClusterMgr:
                 "scopes": dict(self.scopes),
                 "services": {k: list(v) for k, v in self.services.items()},
                 "config": dict(self.config),
+                "tiermap": [[v, b, hv, hb]
+                            for (v, b), (hv, hb) in self.tiermap.items()],
             }
 
     def _restore(self, snap: dict):
@@ -179,6 +187,9 @@ class ClusterMgr:
         self.scopes = dict(snap["scopes"])
         self.services = {k: list(v) for k, v in snap["services"].items()}
         self.config = dict(snap["config"])
+        # .get: snapshots from before the tier map existed
+        self.tiermap = {(v, b): (hv, hb)
+                        for v, b, hv, hb in snap.get("tiermap", [])}
 
     def checkpoint(self):
         """Fold the WAL into a fresh snapshot in ONE atomic kv batch: the new
@@ -415,6 +426,37 @@ class ClusterMgr:
         unit.node_id = d.node_id
         unit.vuid = make_vuid(vid, index, unit.epoch)
         return unit
+
+    # -- tier residency (hot Replica3 copies of sustained-hot EC blobs) ------
+
+    def promote_blob(self, vid: int, bid: int, hot_vid: int,
+                     hot_bid: int) -> tuple[int, int]:
+        """Install the redirect iff absent (first committer wins); returns
+        the WINNING residence — a promoter that lost the race frees its
+        own replica set instead of overwriting (and leaking) the winner's."""
+        return self.apply("promote_blob", {"vid": vid, "bid": bid,
+                                           "hot_vid": hot_vid,
+                                           "hot_bid": hot_bid})
+
+    def _op_promote_blob(self, vid: int, bid: int, hot_vid: int, hot_bid: int):
+        return self.tiermap.setdefault((vid, bid), (hot_vid, hot_bid))
+
+    def demote_blob(self, vid: int, bid: int) -> tuple[int, int] | None:
+        """Drop the redirect FIRST (readers fall back to the authoritative EC
+        copy immediately); returns the hot residence so the caller can free
+        its replica shards afterwards."""
+        return self.apply("demote_blob", {"vid": vid, "bid": bid})
+
+    def _op_demote_blob(self, vid: int, bid: int):
+        return self.tiermap.pop((vid, bid), None)
+
+    def hot_location(self, vid: int, bid: int) -> tuple[int, int] | None:
+        with self._lock:
+            return self.tiermap.get((vid, bid))
+
+    def hot_blobs(self) -> dict[tuple[int, int], tuple[int, int]]:
+        with self._lock:
+            return dict(self.tiermap)
 
     # -- service + config mgr ----------------------------------------------
 

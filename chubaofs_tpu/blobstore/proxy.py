@@ -17,6 +17,7 @@ from chubaofs_tpu.utils.locks import SanitizedLock
 
 TOPIC_SHARD_REPAIR = "shard_repair"
 TOPIC_BLOB_DELETE = "blob_delete"
+TOPIC_BLOB_HOT = "blob_hot"  # access-layer heat signals -> tier promoter
 
 
 class TopicQueue:
@@ -87,6 +88,7 @@ class Proxy:
         self.topics = {
             TOPIC_SHARD_REPAIR: TopicQueue(os.path.join(d, "repair.jsonl") if d else None),
             TOPIC_BLOB_DELETE: TopicQueue(os.path.join(d, "delete.jsonl") if d else None),
+            TOPIC_BLOB_HOT: TopicQueue(os.path.join(d, "hot.jsonl") if d else None),
         }
 
     # -- allocator (volumemgr.go:348 Alloc analog) ---------------------------
@@ -123,3 +125,11 @@ class Proxy:
 
     def send_blob_delete(self, vid: int, bid: int) -> None:
         self.topics[TOPIC_BLOB_DELETE].produce({"vid": vid, "bid": bid})
+
+    def send_blob_hot(self, vid: int, bid: int, size: int) -> None:
+        """Heat signal from the cache plane: this blob crossed the promote
+        threshold — the scheduler's tier sweep turns it into a task. `size`
+        is the blob's true byte length (shards alone can't recover it past
+        the stripe padding; the promoter trims the replica copy with it)."""
+        self.topics[TOPIC_BLOB_HOT].produce(
+            {"vid": vid, "bid": bid, "size": size})

@@ -21,7 +21,7 @@ import json
 import os
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 from concurrent.futures import ThreadPoolExecutor
 from concurrent.futures import TimeoutError as FutureTimeout
 from dataclasses import dataclass, field
@@ -39,6 +39,7 @@ from chubaofs_tpu.blobstore.clustermgr import (
 )
 from chubaofs_tpu.blobstore.proxy import (
     TOPIC_BLOB_DELETE,
+    TOPIC_BLOB_HOT,
     TOPIC_SHARD_REPAIR,
     Proxy,
 )
@@ -54,9 +55,13 @@ KIND_SHARD_REPAIR = "shard_repair"
 KIND_DISK_REPAIR = "disk_repair"
 KIND_DISK_DROP = "disk_drop"
 KIND_BALANCE = "balance"
+KIND_TIER_PROMOTE = "tier_promote"
+KIND_TIER_DEMOTE = "tier_demote"
 
-# acquisition priority (service.go:84: repair first)
-_PRIORITY = [KIND_SHARD_REPAIR, KIND_DISK_REPAIR, KIND_DISK_DROP, KIND_BALANCE]
+# acquisition priority (service.go:84: repair first; tier migration is an
+# optimization, so it yields to every durability task)
+_PRIORITY = [KIND_SHARD_REPAIR, KIND_DISK_REPAIR, KIND_DISK_DROP,
+             KIND_BALANCE, KIND_TIER_PROMOTE, KIND_TIER_DEMOTE]
 
 _TASK_STATES = (TASK_PREPARED, TASK_WORKING, TASK_FINISHED, TASK_FAILED)
 
@@ -83,6 +88,7 @@ class Task:
     bad_idx: list[int] = field(default_factory=list)
     disk_id: int = 0
     dest_disk_id: int | None = None  # None = pick at execution
+    size: int = 0  # tier_promote: the blob's true byte length
     created: float = field(default_factory=time.time)
     retries: int = 0
     error: str = ""
@@ -96,13 +102,17 @@ class Scheduler:
     """Leader-elected background brain (single leader here; raft wraps later)."""
 
     def __init__(self, cm: ClusterMgr, proxy: Proxy, nodes: dict[int, BlobNode],
-                 codec: CodecService | None = None, record_log=None):
+                 codec: CodecService | None = None, record_log=None,
+                 cache=None):
         from chubaofs_tpu.blobstore.taskswitch import SwitchMgr
 
         self.cm = cm
         self.proxy = proxy
         self.nodes = nodes
         self.codec = codec or default_service()
+        # the gateway's BlobCache when co-located (MiniCluster): the deleter
+        # punches blobs out of it before shards disappear
+        self.cache = cache
         # switches persist in the clustermgr config KV (task_switch.go:26);
         # pull persisted state so a restarted scheduler honors prior settings
         self.switches = SwitchMgr(config_get=cm.get_config,
@@ -125,6 +135,19 @@ class Scheduler:
         # kill-a-blobnode detection path; generous default so slow test
         # phases never false-positive — the kill soak tightens it)
         self.hb_timeout_s = float(os.environ.get("CFS_HB_TIMEOUT_S", "60"))
+        # tier demotion: a promoted blob that produces NO heat signal for
+        # this many tier sweeps has gone cold — its replica copy is freed
+        # and reads fall back to EC
+        self.demote_sweeps = int(os.environ.get("CFS_DEMOTE_SWEEPS", "8"))
+        self._tier_idle: dict[tuple[int, int], int] = {}  # under self._lock
+        # recently-deleted (vid, bid)s, noted BEFORE the deleter touches
+        # tier/cache state: an in-flight promote re-checks this after
+        # committing its redirect, closing the promote-vs-delete race in
+        # daemon deployments where the two run on different threads.
+        # Bounded LRU; entries only need to outlive the concurrency window
+        # (a promote for a long-gone blob fails on the punched EC read).
+        self._deleted_recent: OrderedDict[tuple[int, int], None] = \
+            OrderedDict()  # under self._lock
         self._lease_seq = 0
         self._lease_deadline: dict[str, float] = {}  # task_id -> monotonic
         self._not_before: dict[str, float] = {}      # requeue backoff gate
@@ -608,6 +631,21 @@ class Scheduler:
         topic = self.proxy.topics[TOPIC_BLOB_DELETE]
         msgs = topic.consume("deleter", max_msgs)
         for m in msgs:
+            # a deleted blob leaves EVERY tier. Order matters on a daemon,
+            # where GETs serve CONCURRENTLY with this loop: (1) note the
+            # delete so an in-flight tier promote re-checks it, (2) drop
+            # the hot replica copy, (3) punch the EC shards, (4) invalidate
+            # the cache LAST — an invalidate-before-punch would let a GET
+            # in the gap refill the cache from the still-readable shards
+            # under the post-bump version, and nothing would ever evict
+            # those bytes again (the gateway's own delete() already did the
+            # pre-delete write-through invalidation for its clients)
+            key = (m["vid"], m["bid"])
+            with self._lock:
+                self._deleted_recent[key] = None
+                while len(self._deleted_recent) > 4096:
+                    self._deleted_recent.popitem(last=False)
+            self._drop_hot_copy(*key)
             vol = self.cm.get_volume(m["vid"])
             for unit in vol.units:
                 node = self.nodes.get(unit.node_id)
@@ -618,8 +656,106 @@ class Scheduler:
                     node.delete_shard(unit.vuid, m["bid"])
                 except Exception:
                     pass  # already gone or never written; repair owns the rest
+            if self.cache is not None:
+                self.cache.invalidate(*key)
         topic.commit("deleter", len(msgs))
         return len(msgs)
+
+    def _recently_deleted(self, vid: int, bid: int) -> bool:
+        with self._lock:
+            return (vid, bid) in self._deleted_recent
+
+    # -- tier migration (the cache plane's promoter/demoter, ISSUE 12) --------
+
+    def run_tier(self, max_msgs: int = 64) -> int:
+        """One tier sweep: drain the hot-blob topic into promote tasks for
+        blobs not yet resident in the hot engine, and create demote tasks
+        for promoted blobs whose heat signal has been silent for
+        demote_sweeps consecutive sweeps. Worker execution rides the same
+        lease machinery as repair (acquire -> lease -> report)."""
+        from chubaofs_tpu.blobstore.taskswitch import SWITCH_TIER_MIGRATE
+
+        topic = self.proxy.topics[TOPIC_BLOB_HOT]
+        # drain the topic FULLY: the idle-demote counter below reads "no
+        # signal this sweep" as cooling, so a partial batch under signal
+        # backlog would demote genuinely hot blobs whose messages merely
+        # sat past the batch boundary (then re-promote them — churn)
+        msgs: list[dict] = []
+        while True:
+            batch = topic.consume("tier", max_msgs)
+            if not batch:
+                break
+            topic.commit("tier", len(batch))
+            msgs.extend(batch)
+        if not self.switches.enabled(SWITCH_TIER_MIGRATE):
+            # consumed-and-DISCARDED: heat signals are advisory, and the
+            # access layer keeps producing them while a cache is armed —
+            # leaving them unconsumed would grow hot.jsonl without bound
+            # and dump an hours-stale backlog on the sweep that re-enables
+            return 0
+        hot_now = {(m["vid"], m["bid"]): m.get("size", 0) for m in msgs}
+        promoted = self.cm.hot_blobs()
+        with self._lock:
+            open_keys = {
+                (t.vid, t.bid)
+                for t in self._tasks.values()
+                if t.kind in (KIND_TIER_PROMOTE, KIND_TIER_DEMOTE)
+                and t.state not in (TASK_FINISHED, TASK_FAILED)
+            }
+        for (vid, bid), size in sorted(hot_now.items()):
+            if (vid, bid) in promoted or (vid, bid) in open_keys:
+                continue
+            open_keys.add((vid, bid))
+            self._new_task(kind=KIND_TIER_PROMOTE, vid=vid, bid=bid, size=size)
+        demote: list[tuple[int, int]] = []
+        with self._lock:
+            # drop idle entries for blobs no longer promoted (demoted or
+            # deleted behind our back) so the table tracks the tier map
+            for key in [k for k in self._tier_idle if k not in promoted]:
+                del self._tier_idle[key]
+            for key in promoted:
+                if key in hot_now:
+                    self._tier_idle[key] = 0
+                    continue
+                n = self._tier_idle.get(key, 0) + 1
+                self._tier_idle[key] = n
+                if n >= self.demote_sweeps and key not in open_keys:
+                    demote.append(key)
+                    del self._tier_idle[key]
+        for vid, bid in demote:
+            self._new_task(kind=KIND_TIER_DEMOTE, vid=vid, bid=bid)
+        return len(msgs)
+
+    def _drop_hot_copy(self, vid: int, bid: int) -> None:
+        """Demote-and-free: drop the tier-map redirect FIRST (readers fall
+        back to the authoritative EC copy), then best-effort delete the
+        replica shards — an unreachable hot node leaks bytes until its
+        chunk is re-imaged, never correctness."""
+        if self.cm.hot_location(vid, bid) is None:
+            # the common case (never promoted): skip the demote apply —
+            # it would mint a durable no-op WAL record per blob delete.
+            # Race-safe vs an in-flight promote: the deleter notes the key
+            # in _deleted_recent BEFORE calling here, and _tier_promote
+            # re-checks that note after committing its redirect
+            return
+        hot = self.cm.demote_blob(vid, bid)
+        if hot is None:
+            return
+        hot_vid, hot_bid = hot
+        try:
+            vol = self.cm.get_volume(hot_vid)
+        except Exception:
+            return
+        for unit in vol.units:
+            node = self.nodes.get(unit.node_id)
+            if node is None:
+                continue
+            try:
+                node.mark_delete_shard(unit.vuid, hot_bid)
+                node.delete_shard(unit.vuid, hot_bid)
+            except Exception:
+                pass
+        registry("cache").counter("demotes").add()
 
 
 class RepairWorker:
@@ -703,6 +839,10 @@ class RepairWorker:
                     self._balance_unit(task)
                 elif task.kind in (KIND_DISK_REPAIR, KIND_DISK_DROP):
                     self._migrate_disk(task, lease)
+                elif task.kind == KIND_TIER_PROMOTE:
+                    self._tier_promote(task, lease)
+                elif task.kind == KIND_TIER_DEMOTE:
+                    self.sched._drop_hot_copy(task.vid, task.bid)
             except Exception as e:
                 ok, err = False, f"{type(e).__name__}: {e}"
             ratio = stage_overlap_ratio(span.stages)
@@ -712,6 +852,114 @@ class RepairWorker:
                             buckets=RATIO_BUCKETS).observe(ratio)
             self.sched.report_task(task.task_id, ok, error=err, lease=lease)
         return True
+
+    # -- tier promotion (EC cold copy -> Replica3 hot engine) ------------------
+
+    def _tier_promote(self, task: Task, lease: int | None = None):
+        """Copy one sustained-hot blob into the 3-replica hot engine: read
+        its data region off the EC stripe (reconstructing around any damage
+        — a hot blob deserves promotion even while degraded), trim to the
+        blob's true size, encode the systematic RS(1,2) replica stripe, and
+        land it on a Replica3 volume before committing the redirect.
+        Idempotent: a re-executed task (lease expiry, crash) sees the
+        redirect and returns; a half-written replica set is unreachable
+        until promote_blob commits, and put_shard punch-and-append makes
+        the rewrite safe."""
+        from chubaofs_tpu.codec.codemode import CodeMode, get_tactic
+
+        if self.cm.hot_location(task.vid, task.bid) is not None:
+            return
+        if self.sched._recently_deleted(task.vid, task.bid):
+            return  # the blob is going/gone; don't resurrect it hot
+        span = trace.current_span()
+        vol = self.cm.get_volume(task.vid)
+        t = vol.tactic()
+        reads = self._probe(vol, task.bid, range(t.N), span=span)
+        if len(reads) == t.N:
+            payload = b"".join(reads[i] for i in range(t.N))
+        else:
+            stripe, present, _ = self._gather(vol, t, task.bid, span=span)
+            missing = [i for i in range(t.N + t.M) if i not in present]
+            if missing:
+                stripe = self.codec.reconstruct(
+                    t.N, t.M, stripe, missing, data_only=True).result()
+            payload = stripe[: t.N].reshape(-1).tobytes()
+        if task.size > 0:
+            payload = payload[: task.size]  # strip the EC stripe padding
+        # a big-blob promote on a degraded stripe (gather + reconstruct)
+        # can outlive one lease: renew before the replica writes, like
+        # _migrate_disk renews per unit — a lost lease means the reaper
+        # may have re-leased this task, and the re-execution owns it now
+        if lease is not None and \
+                not self.sched.renew_lease(task.task_id, lease):
+            raise RuntimeError(
+                f"lease {lease} lost mid-promote of ({task.vid}, {task.bid})")
+        rt = get_tactic(CodeMode.Replica3)
+        mat = np.frombuffer(payload, np.uint8).reshape(1, -1)
+        full = self.codec.encode_tactic(rt, mat).result()
+        hot_vol = self.cm.alloc_volume(int(CodeMode.Replica3))
+        hot_bid, _ = self.cm.alloc_scope("bid", 1)
+        wrote: set[int] = set()
+        for i, unit in enumerate(hot_vol.units):
+            node = self.nodes.get(unit.node_id)
+            if node is None:
+                continue
+            try:
+                node.create_vuid(unit.vuid, unit.disk_id)
+                node.put_shard(unit.vuid, hot_bid, full[i].tobytes())
+                wrote.add(i)
+            except Exception:
+                continue
+        # shard 0 is NOT optional: the hot read path serves only the data
+        # shard, so a redirect whose data replica never landed would send
+        # every GET through a failed hot read before the EC fallback —
+        # worse than no promotion at all
+        if len(wrote) < rt.put_quorum or 0 not in wrote:
+            # take the landed shards back out before failing: no redirect
+            # references them, so nothing else ever would — and every
+            # retry allocs a FRESH hot_bid, so leaked sets would pile up
+            for i in wrote:
+                unit = hot_vol.units[i]
+                node = self.nodes.get(unit.node_id)
+                if node is None:
+                    continue
+                try:
+                    node.mark_delete_shard(unit.vuid, hot_bid)
+                    node.delete_shard(unit.vuid, hot_bid)
+                except Exception:
+                    pass  # best effort; the write just succeeded here
+            raise RuntimeError(
+                f"hot promote of ({task.vid}, {task.bid}): wrote "
+                f"{sorted(wrote)}/{rt.total} replicas, quorum "
+                f"{rt.put_quorum} incl. the data shard")
+        winner = self.cm.promote_blob(task.vid, task.bid, hot_vol.vid,
+                                      hot_bid)
+        if winner != (hot_vol.vid, hot_bid):
+            # first committer won (a re-leased execution of this task beat
+            # us past the lease backstop): OUR replica set is the orphan —
+            # free it; the winner's redirect stands untouched
+            for i in wrote:
+                unit = hot_vol.units[i]
+                node = self.nodes.get(unit.node_id)
+                if node is None:
+                    continue
+                try:
+                    node.mark_delete_shard(unit.vuid, hot_bid)
+                    node.delete_shard(unit.vuid, hot_bid)
+                except Exception:
+                    pass
+            return
+        # delete-race re-check AFTER the commit: the deleter notes the key
+        # BEFORE its own _drop_hot_copy, so either it sees our redirect
+        # (and removes it) or we see its note here (and remove it) — a
+        # promote racing a delete can never leave a dangling hot copy
+        # serving a deleted blob's bytes
+        if self.sched._recently_deleted(task.vid, task.bid):
+            self.sched._drop_hot_copy(task.vid, task.bid)
+            raise RuntimeError(
+                f"blob ({task.vid}, {task.bid}) deleted during promote")
+        registry("cache").counter("promotes").add()
+        registry("cache").counter("promote_bytes").add(len(payload))
 
     # -- single-stripe shard repair -------------------------------------------
 

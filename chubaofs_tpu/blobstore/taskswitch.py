@@ -18,9 +18,11 @@ SWITCH_DISK_DROP = "disk_drop"
 SWITCH_BLOB_DELETE = "blob_delete"
 SWITCH_SHARD_REPAIR = "shard_repair"
 SWITCH_VOL_INSPECT = "vol_inspect"
+SWITCH_TIER_MIGRATE = "tier_migrate"
 
 ALL_SWITCHES = (SWITCH_BALANCE, SWITCH_DISK_REPAIR, SWITCH_DISK_DROP,
-                SWITCH_BLOB_DELETE, SWITCH_SHARD_REPAIR, SWITCH_VOL_INSPECT)
+                SWITCH_BLOB_DELETE, SWITCH_SHARD_REPAIR, SWITCH_VOL_INSPECT,
+                SWITCH_TIER_MIGRATE)
 
 
 class TaskSwitch:

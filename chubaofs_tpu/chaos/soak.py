@@ -379,3 +379,94 @@ def run_kill_soak(root: str, seed: int, n_nodes: int = 9,
         trace.set_finish_hook(prev_hook)
         fp.reset()
         c.close()
+
+
+def run_cache_soak(root: str, seed: int, rounds: int = 4, objects: int = 12,
+                   obj_kb: int = 32, gets_per_round: int = 24,
+                   invalidate_delay: float = 0.05, promote_hits: int = 4,
+                   cache_mb: int = 8) -> dict:
+    """Cache-plane correctness soak (ISSUE 12 satellite): read-after-
+    overwrite and read-after-delete through the tiered read cache, with the
+    `cache.invalidate` failpoint DELAYING every punch-out — the write-
+    through ordering (invalidate completes before the backend delete fans
+    out) must carry correctness even when invalidation is slow.
+
+    Per seeded round: zipfian GETs crc-verified against a per-key ledger
+    (a cache or hot-tier read serving stale/torn bytes fails the soak),
+    overwrites (new location PUT + old location delete, ledger re-keyed),
+    hard deletes (every post-delete GET must error, never serve cached
+    bytes), and a background tick so the deleter, scrubber, and tier
+    promoter/demoter all run against the same traffic. promote_hits is
+    tuned low so blobs cross into (and fall out of) the Replica3 hot
+    engine DURING the soak — the crc ledger then also proves tier
+    migration never changes bytes."""
+    import os as _os
+    import zlib
+
+    from chubaofs_tpu.blobstore.access import AccessError
+    from chubaofs_tpu.blobstore.cache import BlobCache
+    from chubaofs_tpu.blobstore.cluster import MiniCluster
+
+    rnd = random.Random(seed)
+    cache = BlobCache(_os.path.join(root, "cache"), mem_mb=cache_mb,
+                      promote_hits=promote_hits)
+    c = MiniCluster(root, n_nodes=6, cache=cache)
+    stats = {"gets": 0, "overwrites": 0, "deletes": 0, "delete_errors": 0}
+    fp.arm("cache.invalidate", f"delay({invalidate_delay})")
+    try:
+        ledger: dict[int, tuple] = {}  # key -> (loc, crc)
+        for k in range(objects):
+            data = rnd.randbytes(obj_kb * 1024)
+            ledger[k] = (c.access.put(data), zlib.crc32(data))
+        weights = [1.0 / (r + 1) ** 1.1 for r in range(objects)]
+        deleted: dict[int, object] = {}  # key -> dead location
+        for rd in range(rounds):
+            keys = sorted(ledger)
+            for k in rnd.choices(keys, weights=weights[: len(keys)],
+                                 k=gets_per_round):
+                loc, crc = ledger[k]
+                got = c.access.get(loc)
+                stats["gets"] += 1
+                if zlib.crc32(got) != crc:
+                    raise SoakFailure(
+                        f"cache soak seed {seed} round {rd}: key {k} served "
+                        f"stale/corrupt bytes (crc mismatch)")
+            # overwrite: the new location must serve the NEW bytes from its
+            # first read — its fresh bids can never alias a cached entry
+            for k in rnd.sample(sorted(ledger), k=min(2, len(ledger))):
+                old_loc, _ = ledger[k]
+                data = rnd.randbytes(obj_kb * 1024)
+                new_loc = c.access.put(data)
+                c.access.delete(old_loc)  # delayed punch-out via failpoint
+                ledger[k] = (new_loc, zlib.crc32(data))
+                stats["overwrites"] += 1
+                if zlib.crc32(c.access.get(new_loc)) != ledger[k][1]:
+                    raise SoakFailure(
+                        f"cache soak seed {seed} round {rd}: key {k} read "
+                        f"stale bytes immediately after overwrite")
+            # hard delete: after the deleter punches the shards, the old
+            # location must ERROR — cached bytes must not outlive the blob
+            if len(ledger) > objects // 2:
+                k = rnd.choice(sorted(ledger))
+                loc, _ = ledger.pop(k)
+                c.access.delete(loc)
+                deleted[k] = loc
+                stats["deletes"] += 1
+            c.run_background_once()
+            c.run_background_once()  # deleter + tier sweep both settle
+            for k, loc in deleted.items():
+                try:
+                    c.access.get(loc)
+                    raise SoakFailure(
+                        f"cache soak seed {seed} round {rd}: deleted key {k} "
+                        f"still readable (stale cache/tier copy)")
+                except AccessError:
+                    stats["delete_errors"] += 1
+        return {
+            "plan": "cache", "seed": seed, "ok": True, "rounds": rounds,
+            "promoted_peak": len(c.cm.hot_blobs()),
+            "cache_stats": cache.stats(), **stats,
+        }
+    finally:
+        fp.disarm("cache.invalidate")
+        c.close()

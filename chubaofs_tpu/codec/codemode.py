@@ -34,6 +34,12 @@ class CodeMode(enum.IntEnum):
     EC10P4 = 12
     EC6P3 = 13
     EC12P9 = 14
+    # the hot-tier redundancy engine (ISSUE 12): a systematic RS(1,2)
+    # stripe IS the codec-native 3-replica layout — shard 0 is the blob
+    # bytes verbatim (one direct read serves a GET), shards 1-2 are GF
+    # scalar images recoverable through the ordinary reconstruct path.
+    # Never size-selected: blobs enter only via tier promotion.
+    Replica3 = 15
     # test-only modes (kept for parity with the reference's table)
     EC6P6L9 = 200
     EC6P8L10 = 201
@@ -145,6 +151,9 @@ _TACTICS: dict[CodeMode, Tactic] = {
     CodeMode.EC3P3: Tactic(3, 3, 0, 1, put_quorum=5),
     CodeMode.EC10P4: Tactic(10, 4, 0, 1, put_quorum=13),
     CodeMode.EC6P3: Tactic(6, 3, 0, 1, put_quorum=8),
+    # hot tier: exact-size shards (ALIGN_0B) so replica shard 0 == blob
+    CodeMode.Replica3: Tactic(1, 2, 0, 1, put_quorum=2,
+                              min_shard_size=ALIGN_0B),
     # env/test modes
     CodeMode.EC6P3L3: Tactic(6, 3, 3, 3, put_quorum=9),
     CodeMode.EC6P6Align0: Tactic(6, 6, 0, 3, put_quorum=11, min_shard_size=ALIGN_0B),

@@ -653,6 +653,12 @@ def run_capacity(args, rebalance: bool, root: str, out_path: str) -> dict:
         env[k] = v
     if args.failpoints:
         env["CFS_FAILPOINTS"] = args.failpoints
+    if getattr(args, "cache_mb", 0) > 0:
+        # the cache-tier A/B lever: the blobstore daemon's MiniCluster
+        # builds a BlobCache from this env knob, so the harness's zipfian
+        # GET head rides the tiered read plane (cfs_cache_* families then
+        # show up in the capacity report's frames)
+        env["CFS_CACHE_MB"] = str(args.cache_mb)
     master_extra = {}
     if rebalance:
         master_extra["rebalanceHotSecs"] = args.rebalance_secs
@@ -738,6 +744,10 @@ def main(argv=None) -> int:
     p.add_argument("--daemon-env", action="append", default=[],
                    metavar="K=V", help="extra env for daemons (repeatable; "
                    "e.g. CFS_SLO_PUT_P99_MS=20)")
+    p.add_argument("--cache-mb", type=int,
+                   default=env_int("CFS_CACHE_MB", 0),
+                   help="arm the blobstore daemon's tiered read cache with "
+                        "this memory budget (MiB); 0 = cold EC path only")
     p.add_argument("--rebalance", action="store_true",
                    help="arm the master's hot-volume spreading sweep")
     p.add_argument("--rebalance-secs", type=float, default=2.0)

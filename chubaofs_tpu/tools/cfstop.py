@@ -17,6 +17,8 @@ one row per daemon target:
     saturation signal);
   * CODEC/B — mean codec batch occupancy over the window (jobs per drained
     device batch — "is the gateway feeding the chip?");
+  * CACHE% — cache-plane hit ratio over the window (`cfs_cache_hits` /
+    `cfs_cache_lookups` deltas; '-' when the target serves no cache);
   * REPAIRQ — repair tasks outstanding (`cfs_scheduler_tasks` gauge sum).
 
 `--once` renders a single frame (two scrapes `--interval` apart) for CI and
@@ -41,7 +43,7 @@ from chubaofs_tpu.utils.metrichist import (
 from chubaofs_tpu.utils.slo import FAILING, RANK
 
 COLUMNS = ("TARGET", "SLO", "PUT/S", "GET/S", "PUT99MS", "CONNS", "BP/S",
-           "LAG99", "CODEC/B", "REPAIRQ")
+           "LAG99", "CODEC/B", "CACHE%", "REPAIRQ")
 
 
 # -- scraping ------------------------------------------------------------------
@@ -173,6 +175,12 @@ def compute_row(target: str, prev: dict | None, cur: dict | None,
     batches = family_sum(cur, "cfs_codec_batch_jobs_count") \
         - family_sum(prev, "cfs_codec_batch_jobs_count")
     row["codec_occ"] = round(jobs / batches, 2) if batches > 0 else None
+    # cache-plane hit ratio over the window (ISSUE 12); '-' when this
+    # target ran no cached lookups. _rate with dt=1 gives the restart-
+    # clamped window DELTA — the same contract every flow cell rides.
+    lookups = _rate(prev, cur, "cfs_cache_lookups", 1.0)
+    hits = _rate(prev, cur, "cfs_cache_hits", 1.0)
+    row["cache_pct"] = round(100.0 * hits / lookups, 1) if lookups > 0 else None
     return row
 
 
@@ -205,7 +213,8 @@ def render(rows: list[dict], errors: list[str] = ()) -> str:
               _cell(r.get("put_s")), _cell(r.get("get_s")),
               _cell(r.get("put99_ms")), _cell(r.get("conns")),
               _cell(r.get("bp_s")), _cell(r.get("lag99_ms")),
-              _cell(r.get("codec_occ")), _cell(r.get("repair_q"))]
+              _cell(r.get("codec_occ")), _cell(r.get("cache_pct")),
+              _cell(r.get("repair_q"))]
              for r in rows]
     widths = [max(len(COLUMNS[i]), max(len(row[i]) for row in cells))
               for i in range(len(COLUMNS))]

@@ -44,6 +44,12 @@ def main(argv=None) -> int:
                         "kills one node under live PUT load and FAILS if "
                         "rebuild throughput is zero, any repaired stripe "
                         "miscompares, or a WORKING task is stranded")
+    p.add_argument("--cache", action="store_true",
+                   help="run the cache-plane correctness soak (ISSUE 12): "
+                        "zipfian GETs + overwrites + deletes through the "
+                        "tiered read cache with failpoint-DELAYED "
+                        "invalidation; fails on any stale or corrupt byte "
+                        "(crc ledger) or a deleted blob still readable")
     p.add_argument("--hb-timeout", type=float, default=0.75,
                    help="heartbeat-silence window for the kill scenario's "
                         "dead-disk detection (seconds)")
@@ -59,11 +65,23 @@ def main(argv=None) -> int:
         # CONSTRUCTED, so this must precede every component import-and-build
         os.environ["CFS_LOCK_SANITIZER"] = "1"
 
-    from chubaofs_tpu.chaos.soak import SoakFailure, run_kill_soak, run_soak
+    from chubaofs_tpu.chaos.soak import (
+        SoakFailure, run_cache_soak, run_kill_soak, run_soak)
 
-    plans = args.plan or ([] if args.kill_blobnode else ACCEPTANCE_PLANS)
+    plans = args.plan or (
+        [] if (args.kill_blobnode or args.cache) else ACCEPTANCE_PLANS)
     results = []
     ok = True
+    if args.cache:
+        root = (os.path.join(args.root, "cache-soak") if args.root
+                else tempfile.mkdtemp(prefix="chaos-cache-"))
+        try:
+            res = run_cache_soak(root, seed=args.seed, rounds=args.rounds)
+        except SoakFailure as e:
+            ok = False
+            res = {"plan": "cache", "seed": args.seed, "ok": False,
+                   "error": str(e)}
+        results.append(res)
     if args.kill_blobnode:
         root = (os.path.join(args.root, "kill-blobnode") if args.root
                 else tempfile.mkdtemp(prefix="chaos-kill-"))

@@ -704,6 +704,98 @@ def bench_capacity(root: str, duration: float = 3.5, rate: float = 20.0,
     return out
 
 
+def bench_cache_zipf(root: str, objects: int = 32, obj_kb: int = 64,
+                     gets: int = 240, zipf_s: float = 1.1,
+                     wire_ms: float = 2.0, cache_mb: int = 64,
+                     seed: int = 7) -> dict:
+    """Cache-plane A/B (ISSUE 12): the zipfian GET workload the tiered
+    read cache exists for, EC cold path vs frequency-admitted cache tier.
+
+    Two phases over identical payloads and the SAME seeded zipfian access
+    sequence (s≈1.1 — the skew regime of arxiv 1709.05365's object traces):
+    a BASELINE MiniCluster with no cache (every GET pays the full shard
+    gather), and a CACHE-tier cluster (one warm pass, then the measured
+    pass). A deterministic `wire_ms` per-shard-read delay stands in for the
+    gateway->blobnode RTT, same rationale as bench_repair: in-process reads
+    cost ~0, and the cache's win IS skipping N wire round-trips per GET.
+    Every GET is crc-verified against its payload — a cache serving stale
+    or torn bytes fails the bench, not just the numbers. Reports per-GET
+    p50/p99 for both arms, the realized hit ratio, and the p99 speedup."""
+    import random
+    import zlib
+
+    from chubaofs_tpu import chaos
+    from chubaofs_tpu.blobstore.cache import BlobCache
+    from chubaofs_tpu.blobstore.cluster import MiniCluster
+    from chubaofs_tpu.utils import exporter
+
+    rng = random.Random(seed)
+    payloads = [os.urandom(obj_kb * 1024) for _ in range(objects)]
+    crcs = [zlib.crc32(p) for p in payloads]
+    weights = [1.0 / (r + 1) ** zipf_s for r in range(objects)]
+    seq = rng.choices(range(objects), weights=weights, k=gets)
+    reg = exporter.registry("cache")
+
+    def phase(label: str, cache) -> dict:
+        c = MiniCluster(os.path.join(root, label), n_nodes=6, cache=cache)
+        try:
+            locs = [c.access.put(p) for p in payloads]
+            c.access.get(locs[0])  # jit/warm the GET path outside the window
+            if cache is not None:
+                for i in seq:  # warm pass: the zipfian head fills the cache
+                    c.access.get(locs[i])
+            if wire_ms > 0:
+                chaos.arm("blobnode.get_shard", f"delay({wire_ms / 1000.0})")
+            lat: list[float] = []
+            try:
+                for i in seq:
+                    t0 = time.perf_counter()
+                    data = c.access.get(locs[i])
+                    lat.append(time.perf_counter() - t0)
+                    if zlib.crc32(data) != crcs[i]:
+                        raise AssertionError(
+                            f"cache bench crc miscompare on object {i}")
+            finally:
+                if wire_ms > 0:
+                    chaos.disarm("blobnode.get_shard")
+            lat.sort()
+            return {"p50": lat[len(lat) // 2] * 1e3,
+                    "p99": lat[min(len(lat) - 1, int(len(lat) * 0.99))] * 1e3}
+        finally:
+            c.close()
+
+    out: dict = {}
+    # the baseline arm must really be cache-less: MiniCluster(cache=None)
+    # falls back to BlobCache.from_env, so a deployment-exported
+    # CFS_CACHE_MB would silently arm the "EC" arm and flatten the A/B
+    prev_mb = os.environ.pop("CFS_CACHE_MB", None)
+    try:
+        base = phase("ec", None)
+    finally:
+        if prev_mb is not None:
+            os.environ["CFS_CACHE_MB"] = prev_mb
+    lk0 = reg.counter("lookups").value
+    h0 = reg.counter("hits").value
+    cache = BlobCache(os.path.join(root, "cachedir"), mem_mb=cache_mb)
+    cached = phase("cached", cache)
+    lookups = reg.counter("lookups").value - lk0
+    hits = reg.counter("hits").value - h0
+    # warm pass included: the ratio spans fill + steady state, which is the
+    # honest number (a steady-state-only ratio would hide admission churn)
+    out["cache_zipf_hit_ratio"] = round(hits / lookups, 3) if lookups else 0.0
+    out["cache_zipf_p50_ms_ec"] = round(base["p50"], 3)
+    out["cache_zipf_p99_ms_ec"] = round(base["p99"], 3)
+    out["cache_zipf_p50_ms_cached"] = round(cached["p50"], 3)
+    out["cache_zipf_p99_ms_cached"] = round(cached["p99"], 3)
+    out["cache_zipf_speedup_p99"] = round(
+        base["p99"] / cached["p99"], 2) if cached["p99"] > 0 else 0.0
+    log(f"  cache zipf: hit_ratio={out['cache_zipf_hit_ratio']} "
+        f"p99 {out['cache_zipf_p99_ms_ec']}ms (EC) -> "
+        f"{out['cache_zipf_p99_ms_cached']}ms (cached), "
+        f"{out['cache_zipf_speedup_p99']}x")
+    return out
+
+
 def run(root: str, n_files: int = 600, n_clients: int = 4,
         stream_mb: int = 64, metanodes: int = 3, datanodes: int = 3) -> dict:
     from chubaofs_tpu.testing.harness import ProcCluster
@@ -741,6 +833,17 @@ def run(root: str, n_files: int = 600, n_clients: int = 4,
         cfg.update(bench_concurrency())
     else:
         cfg.update(bench_concurrency(clients_axis=(64, 256), ops_per_client=6))
+    # like bench_concurrency, the cache A/B runs AFTER the cluster phases:
+    # its two MiniClusters + tight GET loops leave a throttle-recovering
+    # host deflating the md/stream floors ~2x (measured: create_ops_1c
+    # 12 -> 5.5 with this phase ahead of them); both its arms are
+    # phase-internal, so position costs it nothing
+    log("cache plane (zipfian GET A/B, EC vs cache tier)...")
+    if n_files >= 300:
+        cfg.update(bench_cache_zipf(os.path.join(root, "cachebench")))
+    else:  # smoke invocations get a smoke-size zipf sweep
+        cfg.update(bench_cache_zipf(os.path.join(root, "cachebench"),
+                                    objects=12, obj_kb=32, gets=80))
     _dump_metrics(cfg)
     return cfg
 

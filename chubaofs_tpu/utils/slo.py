@@ -47,10 +47,14 @@ class SLO:
     family, `threshold` the breach bound (value > threshold = breach)."""
 
     name: str
-    kind: str  # "hist_p99_ms" | "error_ratio" | "counter_rate" | "gauge_sum"
+    # "hist_p99_ms" | "error_ratio" | "counter_rate" | "counter_ratio"
+    # | "gauge_sum"
+    kind: str
     family: str
     threshold: float
-    ops_family: str = ""  # error_ratio denominator (a histogram family)
+    # error_ratio denominator (a histogram family); counter_ratio
+    # denominator (a plain counter family)
+    ops_family: str = ""
     # gauge_sum label restriction: (label_key, (allowed values...)) — e.g.
     # a task inventory carries finished/failed series that are history, not
     # backlog; only the live states count toward the objective
@@ -95,6 +99,14 @@ def default_slos() -> list[SLO]:
         SLO("evloop_backpressure", "counter_rate", "cfs_evloop_backpressure",
             _env_f("CFS_SLO_BP_RATE", 16.0),
             description="evloop read-pause events/s"),
+        # cache plane (ISSUE 12): sustained miss ratio above threshold means
+        # the zipfian hot head is NOT being absorbed — admission broken,
+        # budget too small, or an invalidation storm. Absent families (no
+        # cache configured on this role) evaluate to None and never breach.
+        SLO("cache_miss_ratio", "counter_ratio", "cfs_cache_misses",
+            _env_f("CFS_SLO_CACHE_MISS", 0.95),
+            ops_family="cfs_cache_lookups",
+            description="block-cache miss ratio (misses/lookups)"),
     ]
 
 
@@ -141,6 +153,16 @@ def _eval_window(slo: SLO, window: list[dict],
         if ops <= 0:
             return None if errs <= 0 else 1.0  # errors with zero completions
         return errs / ops
+    if slo.kind == "counter_ratio":
+        # two plain counter families, numerator over denominator (the cache
+        # miss-ratio shape); same restart contract as error_ratio
+        if len(window) < 2:
+            return None
+        num = _restart_delta(first, last, slo.family)
+        den = _restart_delta(first, last, slo.ops_family)
+        if den <= 0:
+            return None  # no lookups in the window: a quiet cache is healthy
+        return num / den
     if slo.kind == "counter_rate":
         if len(window) < 2:
             return None
