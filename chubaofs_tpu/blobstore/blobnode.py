@@ -757,6 +757,16 @@ class BlobNode:
             self._reg.counter("scrub_scanned_shards").add(scanned)
         if bad:
             self._reg.counter("scrub_bad_shards").add(len(bad))
+            # a finding is a TRANSITION (healthy bytes -> detected bitrot):
+            # one timeline record per tick, the shard ids in the detail —
+            # never a metric label (obslint rule 1)
+            from chubaofs_tpu.utils import events
+
+            events.emit("scrub_finding", events.SEV_WARNING,
+                        entity=f"node{self.node_id}",
+                        detail={"node_id": self.node_id,
+                                "bad": [[v, b] for v, b in bad],
+                                "scanned": scanned})
         return {"scanned": scanned, "bad": bad, "complete": complete}
 
     def heartbeat(self, cm, broken_after: int = 3) -> None:
@@ -777,7 +787,8 @@ class BlobNode:
                     # (repair done, error count never reset) as broken would
                     # mint an endless broken->repair->dropped->broken cycle
                     if cm.disk_status(disk_id) == DISK_NORMAL:
-                        cm.set_disk_status(disk_id, DISK_BROKEN)
+                        cm.set_disk_status(disk_id, DISK_BROKEN,
+                                           reason="io_errors")
                 except Exception:
                     pass  # control plane unreachable: retried next beat
                 continue  # a broken disk stops heartbeating as healthy

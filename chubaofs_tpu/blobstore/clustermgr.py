@@ -22,6 +22,7 @@ import time
 from dataclasses import dataclass, field
 
 from chubaofs_tpu.codec.codemode import CodeMode, get_tactic
+from chubaofs_tpu.utils import events
 from chubaofs_tpu.utils.locks import SanitizedRLock
 
 DISK_NORMAL = "normal"
@@ -110,6 +111,7 @@ class ClusterMgr:
 
             self._db = open_kv(os.path.join(data_dir, "kv"))
             self._load()
+        self._refresh_disk_gauges()
 
     # -- persistence (state in the native kvstore, the RocksDB role of
     # blobstore/common/kvstore under clustermgr) ----------------------------
@@ -253,6 +255,7 @@ class ClusterMgr:
 
     def register_disk(self, disk_id: int, node_id: int, az: int = 0, rack: str = "") -> None:
         self.apply("register_disk", {"disk_id": disk_id, "node_id": node_id, "az": az, "rack": rack})
+        self._refresh_disk_gauges()
 
     def register_disks(self, specs: list[dict]) -> None:
         """Register many disks in ONE batched WAL commit (cluster bring-up:
@@ -260,6 +263,7 @@ class ClusterMgr:
         with self._lock:
             self._apply_batch([
                 ("register_disk", {"az": 0, "rack": "", **s}) for s in specs])
+        self._refresh_disk_gauges()
 
     def _op_register_disk(self, disk_id: int, node_id: int, az: int, rack: str):
         if disk_id not in self.disks:  # racelint: _op_* appliers only run under self._lock (apply/_apply_batch take it)
@@ -302,8 +306,51 @@ class ClusterMgr:
             d = self.disks.get(disk_id)
             return None if d is None else d.status
 
-    def set_disk_status(self, disk_id: int, status: str) -> None:
-        self.apply("set_disk_status", {"disk_id": disk_id, "status": status})
+    def set_disk_status(self, disk_id: int, status: str,
+                        reason: str = "report") -> None:
+        """The ONE public disk-status transition (the error-count path:
+        blobnode heartbeats report broken disks through here; repair
+        completion drops them through here too). The transition lands on
+        the event timeline — a WAL replay does not (it re-applies state,
+        it is not a fresh transition)."""
+        with self._lock:
+            d = self.disks.get(disk_id)
+            old = d.status if d is not None else None
+            self._apply("set_disk_status",
+                        {"disk_id": disk_id, "status": status})
+            # gauge + timeline record land INSIDE the (re-entrant) lock:
+            # the lock serializes every transition, so the timeline's order
+            # matches the state machine's — a repair lease observed after
+            # this broken-flip can never carry an earlier stamp (the same
+            # contract the scheduler's lease emitters keep)
+            self._refresh_disk_gauges()
+            if old != status:
+                self._emit_disk_event(disk_id, old, status, reason)
+
+    def _emit_disk_event(self, disk_id: int, old: str | None, status: str,
+                         reason: str) -> None:
+        with self._lock:
+            node_id = self.disks[disk_id].node_id \
+                if disk_id in self.disks else -1
+        events.emit(
+            "disk_status",
+            events.SEV_CRITICAL if status == DISK_BROKEN else events.SEV_INFO,
+            entity=f"disk{disk_id}",
+            detail={"disk_id": disk_id, "node_id": node_id,
+                    "from": old, "to": status, "reason": reason})
+
+    def _refresh_disk_gauges(self) -> None:
+        """cfs_clustermgr_disks{status} gauges — the broken-disk count the
+        alert plane evaluates (bounded label: the three status literals)."""
+        from chubaofs_tpu.utils.exporter import registry
+
+        with self._lock:
+            counts = {DISK_NORMAL: 0, DISK_BROKEN: 0, DISK_DROPPED: 0}
+            for d in self.disks.values():
+                counts[d.status] = counts.get(d.status, 0) + 1
+        reg = registry("clustermgr")
+        for status, n in counts.items():
+            reg.gauge("disks", {"status": status}).set(n)
 
     def _op_set_disk_status(self, disk_id: int, status: str):
         if disk_id not in self.disks:
@@ -517,6 +564,15 @@ class ClusterMgr:
             for disk_id in stale:
                 self._apply("set_disk_status",
                             {"disk_id": disk_id, "status": DISK_BROKEN})
+            if stale:
+                # under the lock, like set_disk_status: detection events
+                # must stamp before any repair reaction can (causal order)
+                self._refresh_disk_gauges()
+                for disk_id in stale:
+                    # the heartbeat-silence detection path, distinguished
+                    # from the error-count report path on the timeline
+                    self._emit_disk_event(disk_id, DISK_NORMAL, DISK_BROKEN,
+                                          "heartbeat_silence")
         return stale
 
     def volumes_on_disk(self, disk_id: int) -> list[tuple[VolumeInfo, VolumeUnit]]:

@@ -432,8 +432,11 @@ class Scheduler:
         requeues with backoff. Capture task.lease IMMEDIATELY — the shared
         Task object's lease advances if the task is ever re-leased."""
         now = time.monotonic()
+        got: Task | None = None
         with self._lock:
             for kind in _PRIORITY:
+                if got is not None:
+                    break
                 for t in self._tasks.values():
                     if t.kind != kind or t.state != TASK_PREPARED:
                         continue
@@ -451,8 +454,20 @@ class Scheduler:
                     self._lease_deadline[t.task_id] = \
                         now + self.lease_ms / 1e3
                     self._update_gauges_locked()
-                    return t
-        return None
+                    got = t
+                    # emit UNDER the lock: the lock serializes every lease
+                    # transition, so stamping here keeps the timeline's
+                    # order identical to the state machine's (an expiry's
+                    # event can never trail its re-acquisition's), and the
+                    # mutable lease field is captured before it can advance
+                    from chubaofs_tpu.utils import events
+
+                    events.emit("lease_acquired", entity=t.task_id,
+                                detail={"kind": t.kind, "lease": t.lease,
+                                        "disk_id": t.disk_id, "vid": t.vid,
+                                        "bid": t.bid})
+                    break
+        return got
 
     def reap_expired(self) -> int:
         """Requeue WORKING tasks whose lease deadline passed (the junk-task
@@ -463,6 +478,8 @@ class Scheduler:
         max_lease_expiries times goes terminal FAILED instead — workers
         renew mid-task (renew_lease), so repeated expiry means every
         execution dies, and re-executing forever is not an error path."""
+        from chubaofs_tpu.utils import events
+
         now = time.monotonic()
         reaped = 0
         failed = 0
@@ -489,6 +506,18 @@ class Scheduler:
                         self.requeue_backoff_cap_s,
                         self.requeue_backoff_s * (2 ** (n - 1)))
                 reaped += 1
+                # emit UNDER the lock (same rationale as acquire_task's):
+                # the expiry's timeline stamp must precede any
+                # re-acquisition's, and only the lock guarantees that
+                terminal = t.state == TASK_FAILED
+                events.emit("lease_expired", events.SEV_WARNING,
+                            entity=t.task_id,
+                            detail={"kind": t.kind, "expiries": n,
+                                    "terminal": terminal})
+                if terminal:
+                    events.emit("task_failed", events.SEV_CRITICAL,
+                                entity=t.task_id,
+                                detail={"kind": t.kind, "error": t.error})
             if failed:
                 self._prune_terminal_locked()
             if reaped:
@@ -562,6 +591,24 @@ class Scheduler:
                 self.record_log.encode(record)
             except OSError:
                 pass
+        if t.state in (TASK_FINISHED, TASK_FAILED):
+            # terminal transition -> timeline. Emitted from the WORKER'S
+            # calling context, so a live repair span's trace id rides along
+            # and `cfs-events --correlate <trace>` joins the rebuild-finished
+            # event to its repair trace
+            from chubaofs_tpu.utils import events
+
+            if t.state == TASK_FINISHED:
+                events.emit("task_finished", entity=t.task_id,
+                            detail={"kind": t.kind, "vid": t.vid,
+                                    "bid": t.bid, "disk_id": t.disk_id,
+                                    "retries": t.retries})
+            else:
+                events.emit("task_failed", events.SEV_CRITICAL,
+                            entity=t.task_id,
+                            detail={"kind": t.kind, "vid": t.vid,
+                                    "bid": t.bid, "disk_id": t.disk_id,
+                                    "retries": t.retries, "error": t.error})
         return True
 
     def _update_gauges_locked(self) -> None:
@@ -742,6 +789,11 @@ class Scheduler:
         if hot is None:
             return
         hot_vid, hot_bid = hot
+        from chubaofs_tpu.utils import events
+
+        events.emit("tier_demote", entity=f"blob({vid},{bid})",
+                    detail={"vid": vid, "bid": bid, "hot_vid": hot_vid,
+                            "hot_bid": hot_bid})
         try:
             vol = self.cm.get_volume(hot_vid)
         except Exception:
@@ -960,6 +1012,12 @@ class RepairWorker:
                 f"blob ({task.vid}, {task.bid}) deleted during promote")
         registry("cache").counter("promotes").add()
         registry("cache").counter("promote_bytes").add(len(payload))
+        from chubaofs_tpu.utils import events
+
+        events.emit("tier_promote", entity=f"blob({task.vid},{task.bid})",
+                    detail={"vid": task.vid, "bid": task.bid,
+                            "hot_vid": hot_vol.vid, "hot_bid": hot_bid,
+                            "bytes": len(payload)})
 
     # -- single-stripe shard repair -------------------------------------------
 

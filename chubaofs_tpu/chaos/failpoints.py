@@ -178,11 +178,19 @@ def arm(name: str, action: str, node: int | None = None,
                 seed=seed, name=name)
     with _LOCK:
         _ARMS.setdefault(name, []).append(a)
+    # arming is a state transition the forensics timeline needs: an injected
+    # fault and its downstream detections then sort onto ONE timeline.
+    # Lazy import keeps the unarmed failpoint() fast path untouched.
+    from chubaofs_tpu.utils import events
+
+    events.emit("failpoint_armed", events.SEV_WARNING, entity=name,
+                detail={"name": name, "action": a.describe()})
 
 
 def disarm(name: str | None = None, node: int | None = None) -> None:
     """Disarm one name (optionally only its per-`node` armings) or, with no
     name, everything. Hung waiters of removed armings are released."""
+    removed: list[str] = []
     with _LOCK:
         names = [name] if name is not None else list(_ARMS)
         for n in names:
@@ -193,10 +201,20 @@ def disarm(name: str | None = None, node: int | None = None) -> None:
             for a in arms:
                 if a not in keep:
                     a.gate.set()
+            if len(keep) < len(arms):
+                removed.append(n)
             if keep:
                 _ARMS[n] = keep
             else:
                 _ARMS.pop(n, None)
+    if removed:
+        from chubaofs_tpu.utils import events
+
+        for n in removed:
+            events.emit("failpoint_disarmed", entity=n,
+                        detail={"name": n,
+                                **({"node": node} if node is not None
+                                   else {})})
 
 
 def release(name: str | None = None) -> None:

@@ -122,6 +122,21 @@ class ChaosScheduler:
     def _log(self, event: str, fault: Fault, **details) -> None:
         self.events.append({"t": self.vtime, "event": event,
                             "fault": fault.kind, **details})
+        # mirror the plan step onto the cluster event timeline so the
+        # injected fault sorts against its detections/reactions in
+        # `cfs-events` output. The SEEDED log above stays the determinism
+        # contract; the journal record adds wall/mono stamps for the merge.
+        # A 'skip' step injected NOTHING — it stays in the seeded log only,
+        # never as a chaos_inject record a timeline consumer could anchor on.
+        if event not in ("inject", "lift"):
+            return
+        from chubaofs_tpu.utils import events as ev
+
+        ev.emit("chaos_lift" if event == "lift" else "chaos_inject",
+                ev.SEV_INFO if event == "lift" else ev.SEV_WARNING,
+                entity=fault.kind,
+                detail={"step": event, "t": self.vtime,
+                        "plan": self.plan.name, **details})
 
     def _pick_node(self, fault: Fault) -> int:
         if fault.target is not None:

@@ -36,6 +36,90 @@ class SoakFailure(AssertionError):
     pass
 
 
+class _AlertProbe:
+    """The soak's alert-plane gate: a PRIVATE AlertManager + metric-history
+    ring (never the process defaults — the probe neither inherits instance
+    state from, nor clobbers the cfs_alerts_firing gauge of, whatever
+    serving manager exists in this process; its slo_failing rule evaluates
+    with track_flips=False for the same reason), ticked by the soak loop.
+    Its alert_firing/alert_resolved transition events DO land on the
+    journal — in a MiniCluster soak the probe IS the alert plane, and the
+    lifecycle is exactly the timeline evidence the acceptance reads.
+    `fired`/`firing` are what the gates assert on."""
+
+    def __init__(self, infra_only: bool = False):
+        from chubaofs_tpu.utils.alerts import AlertManager, default_rules
+        from chubaofs_tpu.utils.metrichist import MetricHistory
+
+        rules = default_rules()
+        if infra_only:
+            # the kill soak's exactly-one-alert contract: SLO burn windows
+            # legitimately flip while a node is dead (PUT quorums reject,
+            # p99 inflates — that's detection, and the capacity harness
+            # owns gating it); the deterministic lifecycle this soak proves
+            # is the INFRASTRUCTURE alert: broken disks fire, then resolve
+            rules = [r for r in rules if r.kind != "slo_failing"]
+        self.hist = MetricHistory(maxlen=64)
+        self.am = AlertManager(rules=rules, private=True)
+
+    def tick(self) -> None:
+        self.hist.record()
+        self.am.evaluate(self.hist.snapshots())
+
+    def fired(self) -> list[str]:
+        return self.am.fired_names()
+
+    def firing(self) -> list[str]:
+        return sorted({a["name"] for a in self.am.firing()})
+
+
+def _timeline_events(journal, seq0: int) -> list[dict]:
+    evs, _ = journal.query(since=seq0, n=10 ** 6)
+    return evs
+
+
+def _assert_causal_order(evs: list[dict], seed: int) -> list[dict]:
+    """The kill soak's timeline acceptance: the injected kill, the broken-
+    disk detection, the repair lease, and the rebuild-finished terminal
+    event must all be PRESENT and in causal (monotonic) order — and the
+    rebuild-finished event must carry the repair trace id so `cfs-events
+    --correlate` can join it to the repair spans. Returns the four anchor
+    events, in order."""
+
+    def first(pred, what: str) -> dict:
+        for e in evs:
+            if pred(e):
+                return e
+        raise SoakFailure(
+            f"kill soak seed {seed}: timeline has no {what} event "
+            f"({len(evs)} events on the journal)")
+
+    kill = first(lambda e: e["type"] == "chaos_inject"
+                 and e["entity"] == "node_kill", "chaos_inject/node_kill")
+    broken = first(lambda e: e["type"] == "disk_status"
+                   and e["detail"].get("to") == "broken", "disk_broken")
+    lease = first(lambda e: e["type"] == "lease_acquired"
+                  and e["detail"].get("kind") == "disk_repair",
+                  "disk-repair lease_acquired")
+    finishes = [e for e in evs if e["type"] == "task_finished"
+                and e["detail"].get("kind") == "disk_repair"]
+    if not finishes:
+        raise SoakFailure(f"kill soak seed {seed}: timeline has no "
+                          f"disk-repair task_finished (rebuild-finished)")
+    done = finishes[-1]
+    chain = [kill, broken, lease, done]
+    monos = [e["mono"] for e in chain]
+    if monos != sorted(monos):
+        raise SoakFailure(
+            f"kill soak seed {seed}: timeline out of causal order: "
+            + " -> ".join(f"{e['type']}@{e['mono']:.3f}" for e in chain))
+    if not done.get("trace_id"):
+        raise SoakFailure(
+            f"kill soak seed {seed}: rebuild-finished event carries no "
+            f"trace id (cfs-events --correlate would find nothing)")
+    return chain
+
+
 def run_soak(root: str, plan: FaultPlan | str, seed: int, rounds: int = 6,
              puts_per_round: int = 2, n_nodes: int = 9, disks_per_node: int = 2,
              sizes: list[int] | None = None, read_deadline: float = 0.5,
@@ -54,6 +138,11 @@ def run_soak(root: str, plan: FaultPlan | str, seed: int, rounds: int = 6,
     rnd = random.Random(seed)          # op schedule
     rng = np.random.default_rng(seed)  # payload bytes
     c = MiniCluster(root, n_nodes=n_nodes, disks_per_node=disks_per_node)
+    # alert-plane probe: a CLEAN cluster (pre-fault) must evaluate quiet —
+    # that's the gate; alerts firing while a fault window is ACTIVE are the
+    # plane WORKING (a wedged node legitimately burns put_p99) and are
+    # reported as evidence, not failed on
+    probe = _AlertProbe()
     # soak-tuned gateway: a wedged node must cost fractions of a second, not
     # the production 3s/10s windows, and hung reads pin pool workers until
     # the fault lifts — size the pools for that (the displaced stock gateway
@@ -71,6 +160,7 @@ def run_soak(root: str, plan: FaultPlan | str, seed: int, rounds: int = 6,
     next_id = 0
     pending: list[bytes] = []  # payloads rejected under faults, to retry
     try:
+        gated_clean = False
         for _ in range(rounds):
             for _ in range(puts_per_round):
                 size = rnd.choice(sizes)
@@ -91,6 +181,17 @@ def run_soak(root: str, plan: FaultPlan | str, seed: int, rounds: int = 6,
                     retry.append(data)  # never acked: retry after heal
             pending = retry
 
+            # the clean-cluster gate: before the FIRST injection, the rule
+            # set must evaluate quiet (plans inject at step >= 1, so round
+            # 0 always exercises this)
+            if not gated_clean and sched.quiesced():
+                probe.tick()
+                if probe.fired():
+                    raise SoakFailure(
+                        f"plan {plan.name} seed {seed}: alerts fired on a "
+                        f"clean pre-fault cluster: {probe.fired()}")
+                gated_clean = True
+
             sched.step()
 
             # pump the repair planes between faults
@@ -99,6 +200,7 @@ def run_soak(root: str, plan: FaultPlan | str, seed: int, rounds: int = 6,
                 if (s["repair_msgs"] == 0 and s["disk_tasks"] == 0
                         and s["tasks_ran"] == 0):
                     break
+            probe.tick()
 
             # THE invariant: every acked blob reads byte-identical, degraded
             # or healed, inside the latency bound
@@ -138,13 +240,17 @@ def run_soak(root: str, plan: FaultPlan | str, seed: int, rounds: int = 6,
             if c.access.get(loc) != data:
                 raise SoakFailure(
                     f"post-heal: blob {idx} lost under plan {plan.name}")
+        # final evaluation after convergence; fault-window alerts ride the
+        # result as evidence (a wedge burning put_p99 is detection, not a
+        # soak failure — the kill soak owns the fire-then-resolve contract)
+        probe.tick()
         # how often each injection actually bit (anti-vacuous-green signal:
         # a soak whose faults never fire has tested nothing)
         fired = {n: fp.fired(n) for n in
                  ("access.read_shard", "access.write_shard", "raft.send")}
         return {"plan": plan.name, "seed": seed, "events": list(sched.events),
                 "ok": True, "fired": {k: v for k, v in fired.items() if v},
-                **stats}
+                "alerts_fired": probe.fired(), **stats}
     finally:
         sched.close()
         fp.reset()  # never leak armings into the next soak/test
@@ -188,6 +294,8 @@ def run_kill_soak(root: str, seed: int, n_nodes: int = 9,
     from chubaofs_tpu.tools.cfstrace import critical_path, stage_overlap
     from chubaofs_tpu.utils.exporter import registry
 
+    from chubaofs_tpu.utils import events as ev
+
     sizes = sizes or SIZES
     rnd = random.Random(seed)
     rng = np.random.default_rng(seed)
@@ -197,6 +305,13 @@ def run_kill_soak(root: str, seed: int, n_nodes: int = 9,
                       read_deadline=read_deadline,
                       write_deadline=write_deadline)
     c.scheduler.hb_timeout_s = hb_timeout
+    # event-timeline + alert-plane acceptance (ISSUE 13): everything this
+    # soak injects and everything the repair plane does about it must land
+    # on ONE queryable timeline, and the broken-disk alert must FIRE during
+    # the outage and RESOLVE once the rebuild converges
+    journal = ev.default_journal()
+    seq0 = journal.last_seq()
+    probe = _AlertProbe(infra_only=True)
     # capture every repair span for the cfs-trace overlap proof (restore
     # whatever hook — trace sink or none — was installed before us)
     records: list[dict] = []
@@ -237,6 +352,13 @@ def run_kill_soak(root: str, seed: int, n_nodes: int = 9,
                 pass  # pre-kill: a healthy cluster must ack every PUT
         # settle heartbeats once so no disk is stale at kill time
         c.run_background_once()
+        # the clean half of the alert acceptance: before any fault, the
+        # rule set evaluates quiet
+        probe.tick()
+        if probe.fired():
+            raise SoakFailure(
+                f"kill soak seed {seed}: alerts firing BEFORE the kill "
+                f"(stale state or broken rules): {probe.fired()}")
 
         plan = FaultPlan("node_kill", [Fault("node_kill", at=0)])
         sched = ChaosScheduler(c, plan, seed=seed + 1)
@@ -285,6 +407,10 @@ def run_kill_soak(root: str, seed: int, n_nodes: int = 9,
                 statuses = {c.cm.disks[d].status for d in victim_disks}
                 if t_detect is None and statuses != {DISK_NORMAL}:
                     t_detect = time.monotonic()
+                # the outage window: evaluated BEFORE the worker drains, so
+                # the broken->repairing state is observable (one drain pass
+                # can take a small cluster all the way to DROPPED)
+                probe.tick()
                 t0w = time.monotonic()
                 ran = 0
                 while c.worker.run_once():
@@ -355,6 +481,30 @@ def run_kill_soak(root: str, seed: int, n_nodes: int = 9,
             raise SoakFailure(
                 f"kill soak seed {seed}: zero rebuild throughput "
                 f"(no shards repaired after killing node {killed})")
+
+        # the chaos half of the alert acceptance: the outage fired EXACTLY
+        # one named alert (broken_disks) and, now that every victim disk is
+        # DROPPED, it resolves
+        probe.tick()
+        if probe.fired() != ["broken_disks"]:
+            raise SoakFailure(
+                f"kill soak seed {seed}: expected exactly the broken_disks "
+                f"alert to fire during the outage, got {probe.fired()}")
+        if probe.firing():
+            raise SoakFailure(
+                f"kill soak seed {seed}: alerts still firing after the "
+                f"rebuild converged: {probe.firing()}")
+
+        # timeline acceptance: kill -> disk_broken -> repair lease ->
+        # rebuild finished, causally ordered and trace-correlated
+        tl = _timeline_events(journal, seq0)
+        chain = _assert_causal_order(tl, seed)
+        timeline = [{"t": round(e["mono"] - chain[0]["mono"], 3),
+                     "type": e["type"], "entity": e["entity"],
+                     "severity": e["severity"],
+                     **({"trace_id": e["trace_id"]}
+                        if e.get("trace_id") else {})}
+                    for e in chain]
         # the cfs-trace proof: per-repair-trace download/decode overlap
         overlap, best_report = 0.0, None
         for rec in records:
@@ -373,6 +523,10 @@ def run_kill_soak(root: str, seed: int, n_nodes: int = 9,
             "repair_overlap_ratio": round(overlap, 3),
             "repair_traces": len(records),
             "critical_path": best_report,
+            "timeline": timeline,
+            "repair_trace_id": chain[-1].get("trace_id"),
+            "alerts_fired": probe.fired(),
+            "alerts_firing": probe.firing(),
             **stats,
         }
     finally:

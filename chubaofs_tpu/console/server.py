@@ -222,6 +222,45 @@ class Console:
             return Response.json({"status": worst, "targets": targets,
                                   "unreachable": missed})
 
+        def events_rollup(req: Request):
+            """The cluster timeline: every target's /events merged by wall
+            stamp, CURSOR-PAGED — `?cursor=` carries the per-target seq map
+            from the previous poll (JSON), so repeated polls see each event
+            exactly once; a target with no cursor yet serves its NEWEST
+            page. Unreachable targets are REPORTED, never dropped (their
+            cursors stay put so nothing is skipped). The merge itself is
+            cfsevents.fetch_events — ONE implementation behind this rollup
+            and the CLI's direct --addr mode."""
+            from chubaofs_tpu.tools.cfsevents import fetch_events
+
+            cursor: dict = {}
+            raw = req.q("cursor")
+            if raw:
+                try:
+                    cursor = {str(k): int(v)
+                              for k, v in json.loads(raw).items()}
+                except (ValueError, TypeError, AttributeError):
+                    return Response.json(
+                        {"error": "bad ?cursor= (JSON target->seq map)"},
+                        status=400)
+            merged, next_cursor, missed = fetch_events(
+                None, self.master_addrs + self.metrics_addrs,
+                cursor=cursor, n=req.q_int("n", 200),
+                types=req.q("type"), severity=req.q("severity"),
+                timeout=3.0)
+            return Response.json({"events": merged, "cursor": next_cursor,
+                                  "unreachable": missed})
+
+        def alerts_rollup(req: Request):
+            """Every target's /alerts merged: per-target alert lists plus
+            the cluster firing total. An unreachable target is reported as
+            such — an alert plane that can't answer is not 'no alerts'.
+            Same shared implementation as `cfs-events --alerts --addr`."""
+            from chubaofs_tpu.tools.cfsevents import fetch_alerts
+
+            return Response.json(fetch_alerts(
+                None, self.master_addrs + self.metrics_addrs, timeout=3.0))
+
         def slowops_rollup(req: Request):
             """Recent slow-op audit entries from every daemon, each tagged
             with its source target — what `cfs-stat --slowops` renders next
@@ -242,6 +281,8 @@ class Console:
         r.get("/api/health", health_rollup)
         r.get("/api/trace", trace_rollup)
         r.get("/api/slowops", slowops_rollup)
+        r.get("/api/events", events_rollup)
+        r.get("/api/alerts", alerts_rollup)
         r.post("/graphql", graphql_proxy)
         return r
 

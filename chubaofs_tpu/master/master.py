@@ -833,7 +833,14 @@ class Master:
             raise MasterError(f"unknown node {node_id}")
         self._apply("set_node_status", node_id=node_id, status="decommissioned")
         with self._decomm_lock:
-            return self._migrate_metanode(node_id)
+            moved = self._migrate_metanode(node_id)
+        from chubaofs_tpu.utils import events
+
+        events.emit("node_decommissioned", events.SEV_WARNING,
+                    entity=f"node{node_id}",
+                    detail={"node_id": node_id, "kind": "meta",
+                            "moved": moved})
+        return moved
 
     def _migrate_metanode(self, node_id: int) -> int:
         moved = 0
@@ -870,15 +877,25 @@ class Master:
             raise MasterError(f"unknown node {node_id}")
         self._apply("set_node_status", node_id=node_id, status="decommissioned")
         with self._decomm_lock:
-            return self._migrate_datanode(node_id)
+            moved = self._migrate_datanode(node_id)
+        from chubaofs_tpu.utils import events
+
+        events.emit("node_decommissioned", events.SEV_WARNING,
+                    entity=f"node{node_id}",
+                    detail={"node_id": node_id, "kind": "data",
+                            "moved": moved})
+        return moved
 
     def _move_dp_replica(self, vol, dp, node_id: int,
                          prefer_zone: str | None = None,
-                         repl: NodeInfo | None = None) -> None:
+                         repl: NodeInfo | None = None,
+                         reason: str = "decommission") -> None:
         """Move one dp replica off node_id (decommission, dead-node re-home,
         spread-repair and hot-volume rebalance all share this step). An
         explicit `repl` (the rebalancer's load-ranked pick) skips the
-        zone/domain-ranked _pick_addition."""
+        zone/domain-ranked _pick_addition. `reason` tags the timeline event
+        so a rebalance move and a decommission drain are distinguishable
+        forensics."""
         if repl is None:
             repl = self._pick_addition(
                 "data", [p for p in dp.peers if p != node_id],
@@ -906,6 +923,12 @@ class Master:
             # idempotent re-send refreshes peers/hosts on survivors
             # (their local meta still lists the victim)
             self.datanode_hook(dp.partition_id, new_peers, new_hosts)
+        from chubaofs_tpu.utils import events
+
+        events.emit("partition_moved", entity=f"dp{dp.partition_id}",
+                    detail={"partition": dp.partition_id, "vol": vol.name,
+                            "victim": node_id, "replacement": repl.node_id,
+                            "reason": reason})
 
     def _migrate_datanode(self, node_id: int) -> int:
         moved = 0
@@ -914,7 +937,8 @@ class Master:
             for dp in vol.data_partitions:
                 if node_id not in dp.peers:
                     continue
-                self._move_dp_replica(vol, dp, node_id, prefer_zone=zone)
+                self._move_dp_replica(vol, dp, node_id, prefer_zone=zone,
+                                      reason="decommission")
                 moved += 1
         return moved
 
@@ -952,7 +976,8 @@ class Master:
                     doubled[0],
                     key=lambda p: self.sm.nodes[p].partition_count)
                 try:
-                    self._move_dp_replica(vol, dp, victim)
+                    self._move_dp_replica(vol, dp, victim,
+                                          reason="spread_repair")
                     moved += 1
                 except MasterError:
                     pass  # no capacity after all; retried next sweep
@@ -1031,7 +1056,8 @@ class Master:
                     if loads[target.node_id] + pid_load >= loads[nid]:
                         continue  # would not strictly improve the pair
                     try:
-                        self._move_dp_replica(vol, dp, nid, repl=target)
+                        self._move_dp_replica(vol, dp, nid, repl=target,
+                                              reason="rebalance_hot")
                     except MasterError:
                         continue  # no capacity after all; retried next sweep
                     loads[nid] -= pid_load

@@ -308,6 +308,14 @@ class RaftCore:
     def _become_leader(self):
         self.role = ROLE_LEADER
         self.leader = self.id
+        # one timeline record per election, emitted by the WINNER (followers
+        # learning the leader would triple-report every election). emit()
+        # never raises, so the tick path stays safe.
+        from chubaofs_tpu.utils import events
+
+        events.emit("raft_leader", entity=f"g{self.group}",
+                    detail={"group": self.group, "node": self.id,
+                            "term": self.term})
         self.elapsed = 0
         self.next_index = {p: self.last_index + 1 for p in self.peers}
         self.match_index = {p: 0 for p in self.peers}

@@ -439,6 +439,7 @@ class _LoopShard(threading.Thread):
                 self.server.workers.submit(lambda c=conn: self._drain(c))
             if newly_paused:
                 self._bp.add()
+                self._emit_bp("backpressure_on", "inbox")
                 self._set_events(conn, conn.events & ~selectors.EVENT_READ)
 
     # -- dispatch (worker threads) --------------------------------------------
@@ -526,6 +527,7 @@ class _LoopShard(threading.Thread):
             # on this shard never notice.
             conn.paused = True
             self._bp.add()
+            self._emit_bp("backpressure_on", "write_queue")
             self._set_events(conn, selectors.EVENT_WRITE)
 
     def _flush(self, conn: _Conn) -> None:
@@ -569,7 +571,21 @@ class _LoopShard(threading.Thread):
                 and conn.inbox_bytes <= self.server.write_hwm // 2
         if low:
             conn.paused = False
+            self._emit_bp("backpressure_off", "low_water")
             self._set_events(conn, conn.events | selectors.EVENT_READ)
+
+    def _emit_bp(self, etype: str, reason: str) -> None:
+        """Backpressure engage/release -> timeline. A TRANSITION record (the
+        hysteresis means one flip pair per pressure episode per conn, not
+        per op); emit() never raises, so the loop/worker paths stay safe."""
+        from chubaofs_tpu.utils import events
+
+        events.emit(etype,
+                    events.SEV_WARNING if etype == "backpressure_on"
+                    else events.SEV_INFO,
+                    entity=f"{self.server.name}/shard{self.idx}",
+                    detail={"srv": self.server.name, "shard": self.idx,
+                            "reason": reason})
 
 
 class EvloopServer:

@@ -173,6 +173,33 @@ class RPCServer:
             # answering daemon as unreachable
             return Response.json(slo.health_report())
 
+        def events_route(r):
+            from chubaofs_tpu.utils import events
+
+            types = tuple(t for t in (r.q("type") or "").split(",") if t)
+            sevs = tuple(s for s in (r.q("severity") or "").split(",") if s)
+            n = r.q_int("n", 200)
+            j = events.default_journal()
+            if r.has_q("since"):  # q_int clamps negatives: presence IS mode
+                since = r.q_int("since", 0)
+                # cursor-paged poller mode (the console rollup): oldest
+                # first from the cursor, exactly-once delivery
+                evs, cursor = j.query(since=since, n=n,
+                                      types=types or None,
+                                      severity=sevs or None)
+            else:
+                # one-shot mode (bare cfs-events, --correlate): the NEWEST
+                # n matching events — a busy daemon's ring must not hide
+                # fresh events behind its oldest page
+                evs, cursor = events.recent_page(n, types or None,
+                                                 sevs or None)
+            return Response.json({"events": evs, "cursor": cursor})
+
+        def alerts_route(r):
+            from chubaofs_tpu.utils import alerts
+
+            return Response.json(alerts.alerts_report())
+
         if metrics:
             router.get("/metrics", metrics_route)
             router.get("/traces", traces_route)
@@ -181,13 +208,17 @@ class RPCServer:
             router.get("/debug/prof", debug_prof_route)
             router.get("/metrics/history", metrics_history_route)
             router.get("/health", health_route)
+            router.get("/events", events_route)
+            router.get("/alerts", alerts_route)
             # env-armed sinks go live at daemon boot, not first scrape —
             # and stay the documented no-op when their env knob is unset
-            from chubaofs_tpu.utils import metrichist, profiler, tracesink
+            from chubaofs_tpu.utils import alerts, metrichist, profiler, \
+                tracesink
 
             tracesink.activate_from_env()
             profiler.activate_from_env()
             metrichist.activate_from_env()
+            alerts.activate_from_env()
 
         outer = self
         self._inflight = 0
@@ -275,6 +306,25 @@ class RPCServer:
         self.addr = f"{host}:{self.httpd.server_address[1]}"
         self.port = self.httpd.server_address[1]
         self._thread: threading.Thread | None = None
+        if metrics:
+            # identity + boot stamp (the events satellite): every daemon
+            # exports cfs_boot_time_seconds (wall, cross-process protocol —
+            # scrapers derive UP and the restart cross-check from it) and a
+            # role/version info gauge; the journal gets the role/addr stamp
+            # and one daemon_boot timeline record
+            import chubaofs_tpu
+            from chubaofs_tpu.utils import events, exporter
+
+            # cfs_boot_time_seconds + cfs_build_info{role,version}
+            exporter.registry("boot").gauge("time_seconds").set(
+                events.BOOT_TS)
+            exporter.registry("build").gauge(
+                "info", {"role": module or "rpc",
+                         "version": chubaofs_tpu.__version__}).set(1)
+            events.configure(role=module or "rpc", addr=self.addr)
+            events.emit("daemon_boot", entity=module or "rpc",
+                        detail={"role": module or "rpc", "addr": self.addr,
+                                "version": chubaofs_tpu.__version__})
 
     def start(self):
         self._thread = threading.Thread(target=self.httpd.serve_forever,

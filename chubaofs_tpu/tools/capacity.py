@@ -512,10 +512,52 @@ class Collector(threading.Thread):
         self.worst = OK
         self.flipped: dict[str, set] = {}
         self.poll_errors = 0
+        # the event/alert timeline rides the same report (ISSUE 13): each
+        # frame archives the events that arrived since the last poll
+        # (cursor-paged, so nothing double-archives) and the alerts
+        # currently firing; the verdict NAMES every alert that fired
+        self._events_cursor: dict = {}
+        self.alerts_fired: dict[str, set] = {}  # target -> rule names
 
     def stop(self, timeout: float = 30.0) -> None:
         self._halt.set()
         self.join(timeout=timeout)
+
+    def _poll_timeline(self, rec: dict) -> None:
+        """Fold the since-last-poll event slice and the firing alerts into
+        this frame's archive record. Best-effort per surface: a console
+        that predates the event plane costs a poll error, never the frame."""
+        from chubaofs_tpu.tools.cfsevents import fetch_alerts, fetch_events
+
+        try:
+            evs, cursor, _ = fetch_events(self.console, self.addrs,
+                                          cursor=self._events_cursor, n=500)
+            self._events_cursor = cursor
+            rec["events"] = [
+                {"ts": e.get("ts"), "type": e.get("type"),
+                 "severity": e.get("severity"), "entity": e.get("entity"),
+                 "target": e.get("target", ""), "detail": e.get("detail")}
+                for e in evs]
+        except Exception:
+            rec["events"] = None  # surface unavailable, distinct from []
+            with self._lock:
+                self.poll_errors += 1
+        try:
+            roll = fetch_alerts(self.console, self.addrs)
+            firing: dict[str, list[str]] = {}
+            for row in roll.get("targets", ()):
+                names = sorted({a["name"] for a in row.get("alerts", ())
+                                if a.get("state") == "firing"})
+                if names:
+                    firing[row["target"]] = names
+                    with self._lock:
+                        self.alerts_fired.setdefault(
+                            row["target"], set()).update(names)
+            rec["alerts"] = firing
+        except Exception:
+            rec["alerts"] = None
+            with self._lock:
+                self.poll_errors += 1
 
     def _poll_once(self, t0: float, prev: dict) -> dict:
         from chubaofs_tpu.tools.cfstop import (
@@ -524,6 +566,7 @@ class Collector(threading.Thread):
         cur = fetch_frame(self.console, self.addrs)
         rows = compute_rows(prev, cur)
         rec = frame_record(t0, cur, rows)
+        self._poll_timeline(rec)
         flips = failing_slos(cur["health"])
         statuses = [h.get("status", FAILING)
                     for h in cur["health"].values()] or [OK]
@@ -575,7 +618,12 @@ class Collector(threading.Thread):
             return {"verdict": FAILING if flipped else self.worst,
                     "flipped": flipped, "frames": self.frames,
                     "health_frames": self.health_frames,
-                    "poll_errors": self.poll_errors}
+                    "poll_errors": self.poll_errors,
+                    # the gate NAMES the alerts that fired during the run —
+                    # the operator reads which rule paged, not just that an
+                    # SLO burn window flipped
+                    "alerts_fired": {t: sorted(n)
+                                     for t, n in self.alerts_fired.items()}}
 
 
 # -- spread measurement (the A/B's metric) -------------------------------------
@@ -792,7 +840,11 @@ def main(argv=None) -> int:
         flipped = result.get("flipped") or {
             **result.get("off", {}).get("flipped", {}),
             **result.get("on", {}).get("flipped", {})}
-        print(f"CAPACITY GATE FAILED: {json.dumps(flipped)}",
+        alerts = result.get("alerts_fired") or {
+            **result.get("off", {}).get("alerts_fired", {}),
+            **result.get("on", {}).get("alerts_fired", {})}
+        print(f"CAPACITY GATE FAILED: {json.dumps(flipped)}"
+              f" alerts={json.dumps(alerts)}",
               file=sys.stderr)
         return 1
     return 0

@@ -1,0 +1,288 @@
+"""cfs-events — the merged cluster event timeline + alert view.
+
+The forensics companion to cfs-top: where the dashboard shows the cluster's
+state NOW, this shows what CHANGED — every daemon's event journal (disk
+transitions, repair leases, tier migrations, raft elections, backpressure
+flips, SLO flips, chaos injections, alert lifecycle) merged into one
+wall-clock-ordered timeline via the console's `/api/events` rollup
+(cursor-paged; `--addr` polls daemons' `/events` side-doors directly).
+
+    cfs-events --console 127.0.0.1:8500 --since 600
+    cfs-events --console C --type disk_status,task_finished --follow
+    cfs-events --console C --alerts
+    cfs-events --console C --correlate 8f3a...   # events ⋈ trace spans
+
+`--correlate <trace-id>` joins the timeline against the trace sink: events
+carrying that trace id and the trace's spans (console `/api/trace`, or each
+daemon's `/traces`) interleave into one causally-ordered view — the
+injected-fault → detection → repair-lease → rebuild-finished chain the
+chaos kill soak asserts on, readable by a human.
+
+`--follow` keeps polling with the rollup cursor, printing only new events
+(tail -f for the cluster). Unreachable targets print as warnings, never
+silently vanish.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+import urllib.parse
+
+SEVERITY_MARK = {"info": " ", "warning": "W", "critical": "C"}
+
+
+# -- fetching ------------------------------------------------------------------
+
+
+def _get_json(addr: str, path: str, timeout: float = 5.0) -> dict:
+    from chubaofs_tpu.tools.cfsstat import scrape
+
+    return json.loads(scrape(addr, path, timeout=timeout))
+
+
+def _fanout_json(addrs: list[str], path_of, timeout: float) -> list[tuple]:
+    """[(addr, json-or-None)] fetched CONCURRENTLY — dead daemons cost one
+    timeout, not one per corpse (the console rollup discipline; this is
+    the ONE fan-out both the console /api/* rollups and the CLI's direct
+    --addr mode ride, so the two surfaces cannot drift)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    def one(addr: str):
+        try:
+            return _get_json(addr, path_of(addr), timeout=timeout)
+        except Exception:
+            return None
+
+    with ThreadPoolExecutor(max_workers=min(8, len(addrs) or 1)) as pool:
+        return list(zip(addrs, pool.map(one, addrs)))
+
+
+def fetch_events(console: str | None, addrs: list[str],
+                 cursor: dict | None = None, n: int = 500,
+                 types: str = "", severity: str = "",
+                 timeout: float = 5.0) -> tuple[list[dict], dict, list[str]]:
+    """One timeline page: (events tagged with target, next cursor map,
+    unreachable targets). Console mode rides /api/events; --addr mode (also
+    the implementation BEHIND /api/events) polls each target's /events —
+    newest page when no cursor is held for it, exact oldest-first
+    pagination once one is."""
+    cursor = dict(cursor or {})
+    extra = ""
+    if types:
+        extra += f"&type={urllib.parse.quote(types)}"
+    if severity:
+        extra += f"&severity={urllib.parse.quote(severity)}"
+    if console:
+        q = f"/api/events?n={n}{extra}"
+        if cursor:
+            q += f"&cursor={urllib.parse.quote(json.dumps(cursor))}"
+        out = _get_json(console, q, timeout=timeout)
+        return (out.get("events", []), out.get("cursor", cursor),
+                out.get("unreachable", []))
+
+    def path_of(addr: str) -> str:
+        since = f"since={cursor[addr]}&" if addr in cursor else ""
+        return f"/events?{since}n={n}{extra}"
+
+    merged: list[dict] = []
+    missed: list[str] = []
+    for addr, out in _fanout_json(addrs, path_of, timeout):
+        if out is None:
+            missed.append(addr)  # cursor stays put: nothing is skipped
+            continue
+        cursor[addr] = int(out.get("cursor", cursor.get(addr, 0)))
+        merged.extend({**rec, "target": addr}
+                      for rec in out.get("events", ()))
+    merged.sort(key=lambda e: e.get("ts", 0.0))
+    return merged, cursor, missed
+
+
+def fetch_alerts(console: str | None, addrs: list[str],
+                 timeout: float = 5.0) -> dict:
+    """The merged alert view (also the implementation behind /api/alerts):
+    per-target rows + the cluster firing total, corpses marked."""
+    if console:
+        return _get_json(console, "/api/alerts", timeout=timeout)
+    rows, missed = [], []
+    total = 0
+    for addr, out in _fanout_json(addrs, lambda a: "/alerts", timeout):
+        if out is None or "alerts" not in out:
+            missed.append(addr)
+            rows.append({"target": addr, "unreachable": True, "alerts": [],
+                         "firing": 0})
+            continue
+        rows.append({"target": addr, "alerts": out.get("alerts", []),
+                     "firing": out.get("firing", 0)})
+        total += int(out.get("firing", 0))
+    return {"targets": rows, "firing": total, "unreachable": missed}
+
+
+def fetch_spans(console: str | None, addrs: list[str],
+                trace_id: str, timeout: float = 5.0) -> list[dict]:
+    tid = urllib.parse.quote(trace_id)
+    if console:
+        out = _get_json(console, f"/api/trace?id={tid}", timeout=timeout)
+        return out.get("spans", [])
+    spans: dict[str, dict] = {}
+    for addr in addrs:
+        try:
+            out = _get_json(addr, f"/traces?id={tid}", timeout=timeout)
+        except Exception:
+            continue
+        for rec in out.get("spans", ()):
+            if rec.get("span_id"):
+                spans.setdefault(rec["span_id"], rec)
+    return sorted(spans.values(), key=lambda r: r.get("start", 0.0))
+
+
+# -- rendering -----------------------------------------------------------------
+
+
+def fmt_event(e: dict) -> str:
+    ts = time.strftime("%H:%M:%S", time.localtime(e.get("ts", 0.0)))
+    ms = int((e.get("ts", 0.0) % 1) * 1000)
+    who = e.get("role") or e.get("target") or "-"
+    detail = " ".join(f"{k}={v}" for k, v in (e.get("detail") or {}).items())
+    tid = f" trace={e['trace_id'][:12]}" if e.get("trace_id") else ""
+    return (f"{ts}.{ms:03d} [{SEVERITY_MARK.get(e.get('severity'), '?')}] "
+            f"{who:<12} {e.get('type', '?'):<18} {e.get('entity', ''):<18} "
+            f"{detail}{tid}")
+
+
+def render_alerts(roll: dict) -> str:
+    lines = [f"firing: {roll.get('firing', 0)}"]
+    for row in roll.get("targets", []):
+        tag = " UNREACHABLE" if row.get("unreachable") else ""
+        lines.append(f"{row['target']}{tag}:")
+        for a in row.get("alerts", []):
+            labels = "".join(f" {k}={v}"
+                             for k, v in (a.get("labels") or {}).items())
+            since = time.strftime("%H:%M:%S",
+                                  time.localtime(a.get("since") or 0))
+            sil = " (silenced)" if a.get("silenced") else ""
+            lines.append(f"  [{a.get('state', '?'):>8}] {a['name']}{labels} "
+                         f"value={a.get('value')} since={since}{sil}")
+        if not row.get("alerts"):
+            lines.append("  (no alerts)")
+    for addr in roll.get("unreachable", []):
+        lines.append(f"! {addr}: unreachable")
+    return "\n".join(lines)
+
+
+def correlate(events: list[dict], spans: list[dict],
+              trace_id: str) -> list[dict]:
+    """The join: events carrying the trace id + the trace's spans, merged
+    into one wall-ordered item list ({'t', 'kind', 'line'})."""
+    items: list[dict] = []
+    for e in events:
+        if e.get("trace_id") != trace_id:
+            continue
+        items.append({"t": e.get("ts", 0.0), "kind": "event",
+                      "record": e, "line": fmt_event(e)})
+    for s in spans:
+        start = s.get("start", 0.0)
+        dur_ms = s.get("dur_us", 0) / 1e3
+        ts = time.strftime("%H:%M:%S", time.localtime(start))
+        items.append({
+            "t": start, "kind": "span", "record": s,
+            "line": f"{ts}.{int((start % 1) * 1000):03d} [span] "
+                    f"{s.get('op', '?'):<32} {dur_ms:.2f}ms"})
+    items.sort(key=lambda i: i["t"])
+    return items
+
+
+# -- CLI -----------------------------------------------------------------------
+
+
+def main(argv=None, out=None) -> int:
+    import argparse
+
+    out = out or sys.stdout
+    p = argparse.ArgumentParser(
+        prog="cfs-events",
+        description="merged cluster event timeline + alerts")
+    p.add_argument("--console", default=None,
+                   help="console address (uses /api/events + /api/alerts)")
+    p.add_argument("--addr", action="append", default=[],
+                   help="poll a daemon directly (repeatable; skips console)")
+    p.add_argument("--since", type=float, default=0.0,
+                   help="only events newer than SINCE seconds ago")
+    p.add_argument("--type", default="",
+                   help="comma-separated event types to keep")
+    p.add_argument("--severity", default="",
+                   help="comma-separated severities to keep "
+                        "(info,warning,critical)")
+    p.add_argument("--n", type=int, default=500,
+                   help="page size per target")
+    p.add_argument("--follow", action="store_true",
+                   help="keep polling and print only new events (^C stops)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="--follow poll period (s)")
+    p.add_argument("--alerts", action="store_true",
+                   help="show the merged alert view instead of the timeline")
+    p.add_argument("--correlate", default="", metavar="TRACE_ID",
+                   help="join events against this trace's spans")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    if not args.console and not args.addr:
+        p.error("give --console or --addr")
+
+    if args.alerts:
+        roll = fetch_alerts(args.console, args.addr)
+        print(json.dumps(roll, indent=2) if args.json
+              else render_alerts(roll), file=out)
+        return 0
+
+    events, cursor, missed = fetch_events(
+        args.console, args.addr, n=args.n, types=args.type,
+        severity=args.severity)
+    if args.since > 0:
+        # event records carry WALL stamps (the cross-daemon merge key), so
+        # the --since floor is wall arithmetic by protocol
+        floor = time.time() - args.since  # wallclock: event ts are cross-process wall stamps
+        events = [e for e in events if e.get("ts", 0.0) >= floor]
+
+    if args.correlate:
+        spans = fetch_spans(args.console, args.addr, args.correlate)
+        items = correlate(events, spans, args.correlate)
+        if args.json:
+            print(json.dumps({"trace_id": args.correlate, "items": items},
+                             default=str, indent=2), file=out)
+        else:
+            print(f"trace {args.correlate}: {len(items)} items "
+                  f"({sum(1 for i in items if i['kind'] == 'event')} events, "
+                  f"{sum(1 for i in items if i['kind'] == 'span')} spans)",
+                  file=out)
+            for item in items:
+                print(item["line"], file=out)
+        return 0
+
+    def show(evs: list[dict], missed_now: list[str]):
+        if args.json:
+            print(json.dumps({"events": evs, "unreachable": missed_now},
+                             indent=2), file=out)
+        else:
+            for e in evs:
+                print(fmt_event(e), file=out)
+            for addr in missed_now:
+                print(f"! {addr}: unreachable", file=out)
+
+    show(events, missed)
+    if not args.follow:
+        return 0
+    try:
+        while True:
+            time.sleep(max(0.1, args.interval))
+            events, cursor, missed = fetch_events(
+                args.console, args.addr, cursor=cursor, n=args.n,
+                types=args.type, severity=args.severity)
+            if events or missed:
+                show(events, missed)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

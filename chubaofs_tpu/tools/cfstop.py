@@ -19,7 +19,12 @@ one row per daemon target:
     device batch — "is the gateway feeding the chip?");
   * CACHE% — cache-plane hit ratio over the window (`cfs_cache_hits` /
     `cfs_cache_lookups` deltas; '-' when the target serves no cache);
-  * REPAIRQ — repair tasks outstanding (`cfs_scheduler_tasks` gauge sum).
+  * REPAIRQ — repair tasks outstanding (`cfs_scheduler_tasks` gauge sum);
+  * UP — seconds since the daemon's `cfs_boot_time_seconds` boot stamp. A
+    boot stamp that MOVED between frames is a confirmed restart — the row
+    tags `(restart)` from that cross-check, not just from negative-delta
+    clamping (which a counter reset can also cause);
+  * ALERTS — alert instances currently firing (`cfs_alerts_firing`).
 
 `--once` renders a single frame (two scrapes `--interval` apart) for CI and
 scripts; without it the terminal refreshes in place until ^C. `--addr`
@@ -42,8 +47,8 @@ from chubaofs_tpu.utils.metrichist import (
     family_sum, hist_delta, hist_quantile, parse_key)
 from chubaofs_tpu.utils.slo import FAILING, RANK
 
-COLUMNS = ("TARGET", "SLO", "PUT/S", "GET/S", "PUT99MS", "CONNS", "BP/S",
-           "LAG99", "CODEC/B", "CACHE%", "REPAIRQ")
+COLUMNS = ("TARGET", "SLO", "UP", "PUT/S", "GET/S", "PUT99MS", "CONNS",
+           "BP/S", "LAG99", "CODEC/B", "CACHE%", "REPAIRQ", "ALERTS")
 
 
 # -- scraping ------------------------------------------------------------------
@@ -156,6 +161,19 @@ def compute_row(target: str, prev: dict | None, cur: dict | None,
     # state gauges read from the current frame alone
     row["conns"] = int(family_sum(cur, "cfs_evloop_conns"))
     row["repair_q"] = int(family_sum(cur, "cfs_scheduler_tasks"))
+    row["alerts"] = int(family_sum(cur, "cfs_alerts_firing"))
+    # UP from the boot stamp (wall protocol: the daemon exports ITS wall
+    # boot time, we subtract OUR wall clock — same contract as heartbeats)
+    boot = family_sum(cur, "cfs_boot_time_seconds")
+    now_wall = time.time()
+    row["up_s"] = int(now_wall - boot) if boot > 0 else None
+    if prev:
+        prev_boot = family_sum(prev, "cfs_boot_time_seconds")
+        if boot > 0 and prev_boot > 0 and boot > prev_boot + 0.5:
+            # the boot stamp MOVED between frames: a restart happened, no
+            # counter inference needed — the cross-check the (restart) tag
+            # rides instead of relying only on negative-delta clamping
+            row["restart"] = True
     if not prev:
         # no prior frame for this target (first poll, or its last scrape
         # failed): a delta against zero would render LIFETIME totals as a
@@ -208,13 +226,15 @@ def render(rows: list[dict], errors: list[str] = ()) -> str:
         return "(no targets)" + ("".join(f"\n! {e}" for e in errors))
     worst = max((r["slo"] for r in rows),
                 key=lambda s: RANK.get(s, RANK[FAILING]))
-    cells = [[r["target"], r["slo"] + (" (unreachable)"
-                                       if r.get("unreachable") else ""),
+    cells = [[r["target"], r["slo"]
+              + (" (unreachable)" if r.get("unreachable") else "")
+              + (" (restart)" if r.get("restart") else ""),
+              _cell(r.get("up_s")),
               _cell(r.get("put_s")), _cell(r.get("get_s")),
               _cell(r.get("put99_ms")), _cell(r.get("conns")),
               _cell(r.get("bp_s")), _cell(r.get("lag99_ms")),
               _cell(r.get("codec_occ")), _cell(r.get("cache_pct")),
-              _cell(r.get("repair_q"))]
+              _cell(r.get("repair_q")), _cell(r.get("alerts"))]
              for r in rows]
     widths = [max(len(COLUMNS[i]), max(len(row[i]) for row in cells))
               for i in range(len(COLUMNS))]

@@ -155,6 +155,16 @@ def main(argv=None) -> int:
                 print(f"         t={ev['t']} {ev['event']} {ev['fault']}"
                       + "".join(f" {k}={v}" for k, v in ev.items()
                                 if k not in ("t", "event", "fault")))
+            # the event-plane acceptance evidence: the causal timeline the
+            # soak asserted on, plus the alert lifecycle it observed
+            for te in r.get("timeline") or []:
+                print(f"         timeline +{te['t']}s {te['type']} "
+                      f"{te['entity']}"
+                      + (f" trace={te['trace_id'][:12]}"
+                         if te.get("trace_id") else ""))
+            if "alerts_fired" in r:
+                print(f"         alerts fired={r['alerts_fired']} "
+                      f"still-firing={r.get('alerts_firing', [])}")
         if sanitizer is not None:
             n = len(sanitizer["inversions"])
             print(f"[{'OK ' if n == 0 else 'FAIL'}] lock-sanitizer "

@@ -49,6 +49,17 @@
    are PROTOCOL, and each carries a reasoned `# obslint: <why>` pragma
    saying so.
 
+7. **No ad-hoc state-transition writes outside `utils/`.** A bare
+   `sys.stderr.write(...)` or a hand-rolled audit record (a dict literal
+   carrying an `"audit"` key) in daemon code is a state transition only a
+   log-grep can find — no ring, no rotation, no /events, no cursor, no
+   cluster merge. Transitions route through `utils/events.EventJournal`
+   (`events.emit(...)`), whose records the console `/api/events` rollup and
+   `cfs-events` serve. The sanctioned writers live under `utils/` (the
+   journal itself, the auditlog rotor, the lock sanitizer's stderr audit
+   line); `tools/`/`cli/` stdout-stderr is the user interface, as in rule 6.
+   A reasoned `# obslint: <why>` pragma documents a true protocol line.
+
 Wired into tier-1 (tests/test_obslint.py) so a regression fails fast.
 
 File-walk, pragma, and CLI plumbing live in tools/lintcore.py, shared with
@@ -98,10 +109,27 @@ ALLOWED_WALLCLOCK_FILES = ("authnode/server.py",)
 # `chubaofs_tpu/tools/x.py`) agree — the same contract as path_matches
 PRINT_OK_DIRS = ("tools", "cli")
 
+# rule 7's sanctioned writers: utils/ owns the journal, the auditlog rotor
+# and the sanitizer's structured stderr line; tools/cli stderr is operator
+# diagnostics (their stdout is the interface, rule 6's contract)
+EVENTS_OK_DIRS = ("utils", "tools", "cli")
+
 
 def _in_print_ok_dir(relpath: str) -> bool:
     parts = relpath.replace("\\", "/").split("/")
     return any(seg in PRINT_OK_DIRS for seg in parts[:-1])
+
+
+def _in_events_ok_dir(relpath: str) -> bool:
+    parts = relpath.replace("\\", "/").split("/")
+    return any(seg in EVENTS_OK_DIRS for seg in parts[:-1])
+
+
+def _is_stderr_attr(node: ast.expr) -> bool:
+    """`sys.stderr` (any `import sys as _sys` alias)."""
+    return (isinstance(node, ast.Attribute) and node.attr == "stderr"
+            and isinstance(node.value, ast.Name)
+            and node.value.id.lstrip("_") == "sys")
 
 
 def _is_walltime_call(node: ast.expr) -> bool:
@@ -215,6 +243,28 @@ def lint_source(src: str, relpath: str) -> list[str]:
                 "every log consumer; route through utils/logger.py or the "
                 "structured audit trails, or pragma a protocol line with "
                 "`# obslint: <why>`")
+        # -- rule 7: ad-hoc state-transition writes outside utils/ ----------
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "write" \
+                and _is_stderr_attr(node.func.value) \
+                and not _in_events_ok_dir(relpath) \
+                and not lintcore.has_pragma(src_lines, node.lineno, "obslint"):
+            findings.append(
+                f"{relpath}:{node.lineno}: bare sys.stderr.write( in daemon "
+                "code — a state transition written here reaches no ring, no "
+                "rotation, no /events cursor; route it through "
+                "utils/events.emit() (or pragma a protocol line with "
+                "`# obslint: <why>`)")
+        if isinstance(node, ast.Dict) and not _in_events_ok_dir(relpath) \
+                and any(isinstance(k, ast.Constant) and k.value == "audit"
+                        for k in node.keys if k is not None) \
+                and not lintcore.has_pragma(src_lines, node.lineno, "obslint"):
+            findings.append(
+                f"{relpath}:{node.lineno}: hand-rolled audit dict (literal "
+                "with an 'audit' key) — structured transition records belong "
+                "in utils/events.EventJournal so the console rollup and "
+                "cfs-events can serve them; use events.emit() or pragma "
+                "with `# obslint: <why>`")
         # -- rule 2: ad-hoc self.*stats* = {...} dict counters --------------
         if isinstance(node, ast.Assign) and isinstance(node.value, ast.Dict):
             for tgt in node.targets:

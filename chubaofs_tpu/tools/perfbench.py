@@ -796,11 +796,62 @@ def bench_cache_zipf(root: str, objects: int = 32, obj_kb: int = 64,
     return out
 
 
+def bench_events(root: str, n_events: int = 10_000, puts: int = 6,
+                 blob_kb: int = 64) -> dict:
+    """Events-overhead smoke (ISSUE 13): the plane's two cost contracts.
+
+    (1) Emission is cheap enough to never matter at transition rates:
+    emitting `n_events` journal records (ring + rotating JSONL + counter)
+    is timed wall-clock; the tier-1 floor keeps it under a generous budget.
+
+    (2) THE HOT PATH EMITS NOTHING: a MiniCluster PUT/GET burst — the
+    busiest per-op traffic in the repo — must produce ZERO events, because
+    the plane records transitions, never ops. A nonzero count here is a
+    correctness failure (someone wired emit() into a data path), so the
+    bench raises instead of just reporting."""
+    from chubaofs_tpu.blobstore.cluster import MiniCluster
+    from chubaofs_tpu.utils import events
+
+    journal = events.configure(logdir=os.path.join(root, "events"))
+    t0 = time.perf_counter()
+    for i in range(n_events):
+        events.emit("bench_tick", detail={"i": i})
+    emit_s = time.perf_counter() - t0
+    out = {"events_emit_10k_s": round(emit_s * (10_000 / n_events), 4),
+           "events_emit_us_avg": round(emit_s / n_events * 1e6, 2)}
+
+    c = MiniCluster(os.path.join(root, "evcluster"), n_nodes=6)
+    try:
+        payload = os.urandom(blob_kb * 1024)
+        warm = c.access.put(payload)  # jit/vuid creation outside the count
+        assert c.access.get(warm) == payload
+        seq0 = journal.last_seq()
+        locs = [c.access.put(payload) for _ in range(puts)]
+        for loc in locs:
+            assert c.access.get(loc) == payload
+        hot = journal.last_seq() - seq0
+        out["events_hot_path"] = hot
+        if hot:
+            evs, _ = journal.query(since=seq0, n=20)
+            raise AssertionError(
+                f"hot-path PUT/GET burst emitted {hot} events (the plane "
+                f"records transitions, never per-op traffic): "
+                f"{[e['type'] for e in evs]}")
+    finally:
+        c.close()
+    log(f"  events: emit {out['events_emit_us_avg']}us/event "
+        f"({out['events_emit_10k_s']}s / 10k), hot-path events "
+        f"{out['events_hot_path']}")
+    return out
+
+
 def run(root: str, n_files: int = 600, n_clients: int = 4,
         stream_mb: int = 64, metanodes: int = 3, datanodes: int = 3) -> dict:
     from chubaofs_tpu.testing.harness import ProcCluster
 
     cfg: dict = {}
+    log("event plane (emission overhead + hot-path zero-events)...")
+    cfg.update(bench_events(os.path.join(root, "eventsbench")))
     log("raft commit (group-commit microbench)...")
     cfg.update(bench_raft_commit(os.path.join(root, "raftbench"), n_ops=n_files))
     log("blobstore data-path pipeline (PUT overlap + pooled RPC A/B)...")

@@ -243,6 +243,17 @@ class _SanLock:
             except Exception:
                 pass
             _audit_line("lock_inversion", rec)
+            # the timeline record the alert plane's lock_inversion rule
+            # watches. Lazy import + never-raises: the sanitizer must not
+            # break (or import-cycle) the locked path it instruments
+            try:
+                from chubaofs_tpu.utils import events
+
+                events.emit("lock_inversion", events.SEV_CRITICAL,
+                            entity=f"{rec['first']}->{rec['then']}",
+                            detail=dict(rec))
+            except Exception:
+                pass
         held.append([self, self.name, time.monotonic(), site, tok])
         return True
 

@@ -192,11 +192,27 @@ def _eval_window(slo: SLO, window: list[dict],
     raise ValueError(f"unknown SLO kind {slo.kind!r}")
 
 
+# last published status per SLO name, for flip detection. Module state on
+# purpose: ONE judgment stream per process — the /health evaluation path
+# (health_report) owns it. Secondary evaluators over their own snapshot
+# windows (the alert plane's slo_failing rule, soak probes) pass
+# track_flips=False, or each pass would flip the shared stream back and
+# forth and spray spurious slo_flip pairs onto the timeline.
+_last_status: dict[str, str] = {}
+
+
 def evaluate(slos: list[SLO], snaps: list[dict],
-             fast_n: int | None = None, slow_n: int | None = None) -> dict:
+             fast_n: int | None = None, slow_n: int | None = None,
+             track_flips: bool = True, publish: bool = True) -> dict:
     """Evaluate every SLO over the fast (last CFS_SLO_FAST_N snapshots) and
     slow (last CFS_SLO_SLOW_N) windows; returns the /health payload and
-    publishes cfs_slo_* metrics."""
+    (with publish, the serving-path default) the cfs_slo_* metrics. With
+    track_flips (the /health stream), status CHANGES (ok<->degraded<->
+    failing) land on the event timeline as `slo_flip`, emitted once per
+    transition. A PRIVATE evaluator over its own snapshot windows (a soak
+    probe's slo_failing rule) passes publish=False + track_flips=False so
+    it neither clobbers the shared cfs_slo_status gauges nor ping-pongs the
+    flip stream."""
     from chubaofs_tpu.utils.exporter import registry
 
     fast_n = fast_n or _env_n("CFS_SLO_FAST_N", 3)
@@ -213,6 +229,7 @@ def evaluate(slos: list[SLO], snaps: list[dict],
     fast_win = snaps[-fast_n:]
     slow_win = snaps[-slow_n:]
     sustained_provable = len(slow_win) > len(fast_win)
+    flips: list[tuple[SLO, str, str, float | None, float | None]] = []
     for slo in slos:
         v_fast = _eval_window(slo, fast_win)
         v_slow = _eval_window(slo, slow_win, worst=True)
@@ -233,8 +250,24 @@ def evaluate(slos: list[SLO], snaps: list[dict],
                 f" > {slo.threshold} ({status})")
         if RANK[status] > RANK[worst]:
             worst = status
-        reg.gauge("status", {"slo": slo.name}).set(RANK[status])
-    reg.counter("evaluations").add()
+        if publish:
+            reg.gauge("status", {"slo": slo.name}).set(RANK[status])
+        if track_flips:
+            prev = _last_status.get(slo.name)
+            if prev is not None and prev != status:
+                flips.append((slo, prev, status, v_fast, v_slow))
+            _last_status[slo.name] = status
+    if publish:
+        reg.counter("evaluations").add()
+    for slo, prev, status, v_fast, v_slow in flips:
+        from chubaofs_tpu.utils import events
+
+        sev = (events.SEV_CRITICAL if status == FAILING else
+               events.SEV_WARNING if status == DEGRADED else events.SEV_INFO)
+        events.emit("slo_flip", sev, entity=slo.name,
+                    detail={"from": prev, "to": status,
+                            "fast": v_fast, "slow": v_slow,
+                            "threshold": slo.threshold})
     return {"status": worst, "reasons": reasons, "slos": out}
 
 

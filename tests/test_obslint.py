@@ -142,6 +142,50 @@ def test_flags_bare_print_in_daemon_code():
     assert obslint.lint_source(method, "master/master.py") == []
 
 
+# -- rule 7: state-transition writes route through the event journal -----------
+
+
+def test_flags_bare_stderr_write_in_daemon_code():
+    src = ("import sys\n"
+           "def on_broken(disk):\n"
+           "    sys.stderr.write('disk %d broken\\n' % disk)\n")
+    findings = obslint.lint_source(src, "blobstore/somewhere.py")
+    assert len(findings) == 1 and "events.emit" in findings[0]
+    # aliased sys works too
+    alias = ("import sys as _sys\n"
+             "def f():\n    _sys.stderr.write('x')\n")
+    assert len(obslint.lint_source(alias, "blobstore/x.py")) == 1
+    # utils/ owns the sanctioned writers (journal, auditlog, sanitizer);
+    # tools/cli stderr is operator diagnostics
+    assert obslint.lint_source(src, "utils/locks.py") == []
+    assert obslint.lint_source(src, "tools/perfbench.py") == []
+    assert obslint.lint_source(src, "chubaofs_tpu/utils/locks.py") == []
+    # a reasoned pragma documents a protocol line
+    pragma = ("import sys\n"
+              "def f():\n"
+              "    sys.stderr.write('x')  # obslint: harness parses stderr\n")
+    assert obslint.lint_source(pragma, "blobstore/x.py") == []
+    # writes to other receivers (files, sockets) are not this rule
+    other = "def f(fh):\n    fh.write('x')\n"
+    assert obslint.lint_source(other, "blobstore/x.py") == []
+
+
+def test_flags_handrolled_audit_dict():
+    src = ('def f(disk):\n'
+           '    rec = {"audit": "disk_broken", "disk": disk}\n'
+           '    return rec\n')
+    findings = obslint.lint_source(src, "blobstore/somewhere.py")
+    assert len(findings) == 1 and "EventJournal" in findings[0]
+    # the sanitizer's own audit line lives in utils/ and stays sanctioned
+    assert obslint.lint_source(src, "utils/locks.py") == []
+    pragma = ('def f(d):\n'
+              '    return {"audit": "x", "d": d}  # obslint: legacy consumer\n')
+    assert obslint.lint_source(pragma, "blobstore/x.py") == []
+    # dicts without the audit key are untouched
+    plain = 'def f():\n    return {"kind": "x"}\n'
+    assert obslint.lint_source(plain, "blobstore/x.py") == []
+
+
 def test_flags_sendall_of_encoded_packet():
     import textwrap
 

@@ -64,6 +64,26 @@ def test_repair_bench_smoke_floor(tmp_path):
     assert out["repair_bytes_per_shard"] > 0, out
 
 
+def test_events_overhead_floor(tmp_path):
+    """Tier-1 events gate (ISSUE 13 satellite): emitting 10k journal events
+    (ring + rotating JSONL + counters) stays under a generous wall budget,
+    and a MiniCluster PUT/GET burst emits ZERO events — the plane records
+    transitions, never per-op traffic (the bench itself raises on any
+    hot-path event, so this is a correctness gate, not just a floor)."""
+    from chubaofs_tpu.tools.perfbench import bench_events
+    from chubaofs_tpu.utils import events
+
+    try:
+        out = bench_events(str(tmp_path), n_events=10_000, puts=4,
+                           blob_kb=32)
+    finally:
+        events.reset()  # the bench re-pointed the process journal
+    assert out["events_hot_path"] == 0, out
+    # ~5-15us/event measured on the 2-vCPU dev host; 10x slack for CI
+    assert out["events_emit_10k_s"] < 5.0, out
+    assert out["events_emit_us_avg"] > 0, out
+
+
 @pytest.mark.slow
 def test_perfbench_tool_runs_and_gates(tmp_path):
     # own session so a timeout kill reaps the 7 daemon GRANDCHILDREN too —

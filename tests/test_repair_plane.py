@@ -509,6 +509,28 @@ def test_kill_blobnode_soak_smoke(tmp_path):
     assert res["critical_path"] is not None
     kinds = [(e["event"], e["fault"]) for e in res["events"]]
     assert ("inject", "node_kill") in kinds
+    # ISSUE-13 timeline acceptance: the injected kill, the broken-disk
+    # detection, the repair lease, and the rebuild-finished terminal event
+    # appear in causal order on the event journal (run_kill_soak raises if
+    # not), correlated to the repair trace; exactly the broken_disks alert
+    # fired during the outage and resolved by soak end
+    tl = [t["type"] for t in res["timeline"]]
+    assert tl == ["chaos_inject", "disk_status", "lease_acquired",
+                  "task_finished"], res["timeline"]
+    offsets = [t["t"] for t in res["timeline"]]
+    assert offsets == sorted(offsets)
+    assert res["repair_trace_id"], "rebuild event lost its trace id"
+    assert res["alerts_fired"] == ["broken_disks"]
+    assert res["alerts_firing"] == []
+    # the correlate join `cfs-events --correlate <trace>` rides: the
+    # rebuild-finished event shares a trace id with persisted repair spans
+    from chubaofs_tpu.tools.cfsevents import correlate
+    from chubaofs_tpu.utils import events as ev
+
+    evs, _ = ev.default_journal().query(n=10 ** 6)
+    items = correlate(evs, [], res["repair_trace_id"])
+    assert any(i["kind"] == "event"
+               and i["record"]["type"] == "task_finished" for i in items)
 
 
 # -- pipelined rebuild: overlap math + spans -----------------------------------
