@@ -351,9 +351,14 @@ class MasterAPI:
         return d
 
     def user_create(self, req: Request):
-        # create-time is the one moment the caller gets the secret back
-        return asdict(self.master.create_user(req.q("user"),
-                                              req.q("type", "normal")))
+        # create-time is the one moment the caller gets the secret back.
+        # ak/sk may be caller-supplied (deterministic credentials, so an
+        # operator can put the access keys in a gateway's CFS_QOS_TENANTS
+        # BEFORE the user exists — cfs-capacity --s3 relies on it)
+        return asdict(self.master.create_user(
+            req.q("user"), req.q("type", "normal"),
+            access_key=req.q("ak") or None,
+            secret_key=req.q("sk") or None))
 
     def user_delete(self, req: Request):
         self.master.delete_user(req.q("user"))
@@ -559,8 +564,12 @@ class MasterClient:
     def cluster_stat(self):
         return self.call("/admin/getClusterStat")
 
-    def create_user(self, user: str, user_type: str = "normal"):
-        return self.call(self._path("/user/create", user=user, type=user_type))
+    def create_user(self, user: str, user_type: str = "normal",
+                    ak: str | None = None, sk: str | None = None):
+        kw = {"user": user, "type": user_type}
+        if ak:
+            kw["ak"], kw["sk"] = ak, sk or ""
+        return self.call(self._path("/user/create", **kw))
 
     def delete_user(self, user: str):
         return self.call(self._path("/user/delete", user=user))

@@ -783,13 +783,20 @@ class Master:
 
     # -- user admin (master/user.go analog) -----------------------------------
 
-    def create_user(self, user_id: str, user_type: str = "normal") -> UserInfo:
+    def create_user(self, user_id: str, user_type: str = "normal",
+                    access_key: str | None = None,
+                    secret_key: str | None = None) -> UserInfo:
         import secrets
         import string
 
         alphabet = string.ascii_letters + string.digits
-        ak = "".join(secrets.choice(alphabet) for _ in range(16))
-        sk = "".join(secrets.choice(alphabet) for _ in range(32))
+        # caller-supplied credentials are allowed (deterministic keys let
+        # an operator declare them in a gateway's CFS_QOS_TENANTS before
+        # the user exists); otherwise mint random ones
+        ak = access_key or "".join(secrets.choice(alphabet)
+                                   for _ in range(16))
+        sk = secret_key or "".join(secrets.choice(alphabet)
+                                   for _ in range(32))
         self._apply("create_user", user_id=user_id, access_key=ak,
                     secret_key=sk, user_type=user_type)
         return self.sm.users[user_id]

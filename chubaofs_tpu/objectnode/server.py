@@ -96,7 +96,8 @@ class ObjectNode:
     volume_names(), client(name) -> FsClient, data_backend. FsCluster does."""
 
     def __init__(self, cluster, users: dict[str, dict] | None = None,
-                 region: str = "cfs", anonymous_ok: bool = False):
+                 region: str = "cfs", anonymous_ok: bool = False,
+                 qos=None):
         self.cluster = cluster
         # users: access_key -> {"secret_key": ..., "uid": ...}
         self.users = users or {}
@@ -104,6 +105,29 @@ class ObjectNode:
         self.anonymous_ok = anonymous_ok
         self._vols: dict[str, OSSVolume] = {}
         self.router = self._build_router()
+        # per-tenant QoS plane (ISSUE 14): pass one explicitly or arm via
+        # CFS_QOS_* env. Unarmed (the default) installs NO middleware —
+        # zero per-request overhead, not a disabled check
+        from chubaofs_tpu.utils.qos import QosPlane
+
+        self.qos = qos if qos is not None else QosPlane.from_env()
+        if self.qos is not None:
+            self.router.middleware.append(self._qos_middleware)
+
+    def _qos_middleware(self, req: Request, nxt):
+        """Admission/shaping BEFORE auth: tenant identity is the claimed
+        sigv4 access key (throttling must cost less than the HMAC chain it
+        protects — the signature check still rejects forgeries afterward).
+        Request-body bytes charge the bandwidth plane up front; response
+        bytes are debited after, driving the tenant's bucket negative
+        until the debt refills."""
+        tenant = s3auth.access_key_of(req)
+        deny = self.qos.admit(tenant, len(req.body))
+        if deny is not None:
+            return deny
+        resp = nxt(req)
+        self.qos.debit_out(tenant, len(resp.body))
+        return resp
 
     # -- volume plumbing ---------------------------------------------------------
 

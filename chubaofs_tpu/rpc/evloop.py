@@ -110,7 +110,8 @@ class _Conn:
 
     __slots__ = ("sock", "fd", "framer", "buf", "view", "got", "wq",
                  "wq_bytes", "inbox", "inbox_bytes", "msg_bytes",
-                 "dispatching", "paused", "closed", "events")
+                 "dispatching", "paused", "closed", "events", "greedy",
+                 "close_after")
 
     def __init__(self, sock: socket.socket, framer):
         self.sock = sock
@@ -128,6 +129,12 @@ class _Conn:
         self.paused = False                 # reads stopped by backpressure
         self.closed = False
         self.events = 0                     # currently registered event mask
+        # greedy framers (variable-length protocols: HTTP) consume whatever
+        # arrived via feed_chunk() instead of the exact-size feed() stages
+        self.greedy = bool(getattr(framer, "greedy", False))
+        # a reply asked for connection teardown once it is fully flushed
+        # (HTTP Connection: close); reads stop immediately
+        self.close_after = False
 
     def arm_stage(self) -> None:
         n = self.framer.need()
@@ -327,7 +334,12 @@ class _LoopShard(threading.Thread):
         try:
             sock.setblocking(False)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            conn = _Conn(sock, self.server.framer_factory())
+            framer = self.server.framer_factory()
+            if hasattr(framer, "on_connect"):
+                # variable-length protocols want per-conn identity (the HTTP
+                # framer stamps each request with the peer address)
+                framer.on_connect(sock)
+            conn = _Conn(sock, framer)
             conn.arm_stage()
             conn.events = selectors.EVENT_READ
             self.sel.register(sock, conn.events, conn)
@@ -388,6 +400,8 @@ class _LoopShard(threading.Thread):
     _READ_BUDGET = 1 << 20
 
     def _readable(self, conn: _Conn) -> None:
+        if conn.greedy:
+            return self._readable_greedy(conn)
         consumed = 0
         while consumed < self._READ_BUDGET and not conn.paused \
                 and not conn.closed:
@@ -416,31 +430,65 @@ class _LoopShard(threading.Thread):
             if msg is None:
                 continue
             nbytes, conn.msg_bytes = conn.msg_bytes, 0
-            newly_paused = False
-            with self._lock:
-                conn.inbox.append((msg, nbytes))
-                conn.inbox_bytes += nbytes
-                if conn.inbox_bytes > self.server.write_hwm \
-                        and not conn.paused:
-                    # fast sender, slow handler: parsed requests are piling
-                    # up — stop READING so the flood stays in the kernel
-                    # socket buffer (TCP backpressure to the peer), like the
-                    # threaded path's one-recv-per-dispatch loop bounded it.
-                    # paused flips INSIDE the append's critical section: a
-                    # worker popping this very message must observe it, or
-                    # its low-water resume check can race the pause and
-                    # leave the conn read-paused forever
-                    conn.paused = True
-                    newly_paused = True
-                start = not conn.dispatching
-                if start:
-                    conn.dispatching = True
+            self._enqueue(conn, msg, nbytes)
+
+    def _readable_greedy(self, conn: _Conn) -> None:
+        """Read side for greedy (variable-length) framers: recv into the
+        fixed scratch buffer and hand the framer whatever arrived; it
+        buffers internally (bounded — an oversized header block is ITS
+        error) and returns every message the chunk completed, so one recv
+        can surface a whole pipelined burst."""
+        consumed = 0
+        while consumed < self._READ_BUDGET and not conn.paused \
+                and not conn.closed and not conn.close_after:
+            try:
+                n = conn.sock.recv_into(conn.view)
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                self._close(conn)
+                return
+            if n == 0:  # peer closed
+                self._close(conn)
+                return
+            consumed += n
+            try:
+                msgs = conn.framer.feed_chunk(conn.view[:n])
+            except Exception:
+                self._close(conn)  # hostile or corrupt framing
+                return
+            for msg, nbytes in msgs:
+                self._enqueue(conn, msg, nbytes)
+            if n < len(conn.buf):
+                return  # socket drained for now
+
+    def _enqueue(self, conn: _Conn, msg, nbytes: int) -> None:
+        """Park a parsed message for dispatch (shared by both read paths)."""
+        newly_paused = False
+        with self._lock:
+            conn.inbox.append((msg, nbytes))
+            conn.inbox_bytes += nbytes
+            if conn.inbox_bytes > self.server.write_hwm \
+                    and not conn.paused:
+                # fast sender, slow handler: parsed requests are piling
+                # up — stop READING so the flood stays in the kernel
+                # socket buffer (TCP backpressure to the peer), like the
+                # threaded path's one-recv-per-dispatch loop bounded it.
+                # paused flips INSIDE the append's critical section: a
+                # worker popping this very message must observe it, or
+                # its low-water resume check can race the pause and
+                # leave the conn read-paused forever
+                conn.paused = True
+                newly_paused = True
+            start = not conn.dispatching
             if start:
-                self.server.workers.submit(lambda c=conn: self._drain(c))
-            if newly_paused:
-                self._bp.add()
-                self._emit_bp("backpressure_on", "inbox")
-                self._set_events(conn, conn.events & ~selectors.EVENT_READ)
+                conn.dispatching = True
+        if start:
+            self.server.workers.submit(lambda c=conn: self._drain(c))
+        if newly_paused:
+            self._bp.add()
+            self._emit_bp("backpressure_on", "inbox")
+            self._set_events(conn, conn.events & ~selectors.EVENT_READ)
 
     # -- dispatch (worker threads) --------------------------------------------
 
@@ -467,7 +515,8 @@ class _LoopShard(threading.Thread):
                 reply = self.server.on_message(msg)
                 self.server.dispatch_tp.observe(time.perf_counter() - t0)
                 if reply is not None:
-                    self.send(conn, self.server.encode(reply))
+                    self.send(conn, self.server.encode(reply),
+                              close_after=self.server.close_reply(reply))
             except Exception:
                 # a handler- OR encode-escaping error is conn-fatal (the
                 # threaded path's serve thread died the same way); an error
@@ -479,7 +528,7 @@ class _LoopShard(threading.Thread):
 
     # -- write side ------------------------------------------------------------
 
-    def send(self, conn: _Conn, iov: list) -> None:
+    def send(self, conn: _Conn, iov: list, close_after: bool = False) -> None:
         """Send an iovec on `conn` (worker-thread safe). Fast path: when the
         write queue is empty — no flush in flight, ordering is ours — try a
         direct non-blocking `sendmsg` right here under the shard lock. Most
@@ -487,13 +536,19 @@ class _LoopShard(threading.Thread):
         wake-pipe → select → flush round trip entirely AND spreads the send
         syscalls over the worker pool instead of serializing them through
         the loop thread. Any remainder (EAGAIN/partial) is queued and the
-        loop finishes it under EVENT_WRITE, same as the slow path."""
+        loop finishes it under EVENT_WRITE, same as the slow path.
+
+        `close_after` tears the connection down once THIS iov is fully on
+        the wire (HTTP `Connection: close`): reads stop immediately, the
+        close itself waits for the flush."""
         total = sum(len(b) for b in iov)
         views = [memoryview(b) for b in iov]
         action = None
         with self._lock:
             if conn.closed:
                 return
+            if close_after:
+                conn.close_after = True
             if not conn.wq and hasattr(conn.sock, "sendmsg"):
                 try:
                     sent = conn.sock.sendmsg(views)
@@ -507,6 +562,8 @@ class _LoopShard(threading.Thread):
                     conn.wq.extend(rest)
                     conn.wq_bytes += sum(len(v) for v in rest)
                     action = action or "flush"
+                elif conn.close_after and action is None:
+                    action = "close"  # reply fully on the wire: tear down now
             else:
                 conn.wq.extend(views)
                 conn.wq_bytes += total
@@ -557,6 +614,10 @@ class _LoopShard(threading.Thread):
         if conn.wq:
             self._set_events(conn, conn.events | selectors.EVENT_WRITE)
         else:
+            if conn.close_after:
+                # the Connection: close reply is fully flushed — teardown
+                self._close(conn)
+                return
             self._set_events(conn, conn.events & ~selectors.EVENT_WRITE)
         self._maybe_resume(conn)
 
@@ -564,7 +625,7 @@ class _LoopShard(threading.Thread):
         """Loop-thread re-arm of reads once BOTH watermarks (reply queue and
         parsed-request inbox) are below half — the low-water side of the
         high/low hysteresis."""
-        if conn.closed or not conn.paused:
+        if conn.closed or not conn.paused or conn.close_after:
             return
         with self._lock:
             low = conn.wq_bytes <= self.server.write_hwm // 2 \
@@ -600,12 +661,16 @@ class EvloopServer:
     def __init__(self, listener: socket.socket, on_message, *,
                  name: str = "pkt", framer_factory=PacketFramer,
                  encode=packet_iov, shards: int | None = None,
-                 workers: int | None = None, write_hwm: int | None = None):
+                 workers: int | None = None, write_hwm: int | None = None,
+                 close_reply=None):
         self.listener = listener
         self.on_message = on_message
         self.name = name
         self.framer_factory = framer_factory
         self.encode = encode or (lambda reply: [reply])
+        # does THIS reply end its connection? (HTTP Connection: close); the
+        # packet protocols never do — every conn outlives every reply
+        self.close_reply = close_reply or (lambda reply: False)
         self.reg = registry("evloop")
         self.dispatch_tp = self.reg.summary("dispatch", {"srv": name})
         self.write_hwm = write_hwm if write_hwm is not None \

@@ -199,12 +199,16 @@ class ProcCluster:
         raise TimeoutError(f"{name} printed no boot line")
 
     def stats_addrs(self, timeout: float = 60.0) -> list[str]:
-        """Every running metanode/datanode's /metrics side-door address —
-        the extra scrape targets a console rollup needs beyond the masters
-        and the blobstore gateway."""
+        """Every running metanode/datanode/objectnode's /metrics side-door
+        address — the extra scrape targets a console rollup needs beyond
+        the masters and the blobstore gateway. The objectnode side-door is
+        where the QoS plane's per-tenant metrics and throttle SLOs live
+        (its PUBLIC listener mounts no /metrics: an S3 bucket named
+        "metrics" must stay routable), so `cfs-capacity --s3`'s gate
+        cannot see fairness without it."""
         out = []
         for name in list(self.procs):
-            if not name.startswith(("metanode", "datanode")):
+            if not name.startswith(("metanode", "datanode", "objectnode")):
                 continue
             addr = self.boot_info(name, timeout=timeout).get("stats_addr")
             if addr:

@@ -42,6 +42,7 @@ the generator created and the spread reduction the actuator bought.
 from __future__ import annotations
 
 import bisect
+import itertools
 import json
 import math
 import os
@@ -162,15 +163,17 @@ class CapacityDriver:
     client (PUT returns an opaque location token), metadata and hot-tier
     verbs ride FsClients. `fs()`/`hot_fs()` may be called from worker
     threads concurrently — implementations hand out thread-local clients
-    when the transport needs it."""
+    when the transport needs it. `tenant` rides every blob verb so a
+    multi-tenant surface (the S3 gateway) can present per-tenant
+    credentials; the SDK drivers ignore it."""
 
-    def blob_put(self, data: bytes) -> str:
+    def blob_put(self, data: bytes, tenant: str | None = None) -> str:
         raise NotImplementedError
 
-    def blob_get(self, token: str) -> bytes:
+    def blob_get(self, token: str, tenant: str | None = None) -> bytes:
         raise NotImplementedError
 
-    def blob_delete(self, token: str) -> None:
+    def blob_delete(self, token: str, tenant: str | None = None) -> None:
         raise NotImplementedError
 
     def fs(self):
@@ -207,13 +210,13 @@ class RemoteDriver(CapacityDriver):
                              if self.hot_volume else None)
         return self._tls
 
-    def blob_put(self, data: bytes) -> str:
+    def blob_put(self, data: bytes, tenant: str | None = None) -> str:
         return self.ac.put(data).to_json()
 
-    def blob_get(self, token: str) -> bytes:
+    def blob_get(self, token: str, tenant: str | None = None) -> bytes:
         return self.ac.get(token)
 
-    def blob_delete(self, token: str) -> None:
+    def blob_delete(self, token: str, tenant: str | None = None) -> None:
         self.ac.delete(token)
 
     def fs(self):
@@ -233,13 +236,13 @@ class LocalDriver(CapacityDriver):
         self._fs = cluster.client(cold_volume)
         self._hot = cluster.client(hot_volume) if hot_volume else None
 
-    def blob_put(self, data: bytes) -> str:
+    def blob_put(self, data: bytes, tenant: str | None = None) -> str:
         return self.access.put(data).to_json()
 
-    def blob_get(self, token: str) -> bytes:
+    def blob_get(self, token: str, tenant: str | None = None) -> bytes:
         return self.access.get(token)
 
-    def blob_delete(self, token: str) -> None:
+    def blob_delete(self, token: str, tenant: str | None = None) -> None:
         self.access.delete(token)
 
     def fs(self):
@@ -247,6 +250,86 @@ class LocalDriver(CapacityDriver):
 
     def hot_fs(self):
         return self._hot
+
+
+class S3Driver(CapacityDriver):
+    """Blob verbs over the objectnode S3 surface with PER-TENANT sigv4
+    credentials (ISSUE 14): the tenant mix lands on the gateway the QoS
+    plane shapes, so `cfs-capacity --s3` gates fairness through the same
+    SLO burn-window verdict as every other scenario. Each tenant owns its
+    bucket (`cap-<tenant>`); a PUT mints a fresh key and the returned
+    token is the object path. Any non-2xx — INCLUDING a 429/503 throttle —
+    surfaces as an op error, which is exactly what feeds the error-ratio
+    and per-tenant throttle SLOs the gate reads. Metadata/hot verbs
+    delegate to an inner SDK driver (the S3 dialect has no metadata-op
+    analog)."""
+
+    def __init__(self, s3_addr: str, creds: dict[str, tuple[str, str]],
+                 inner: CapacityDriver | None = None):
+        self.addr = s3_addr
+        self.creds = dict(creds)
+        self.inner = inner
+        self._tls = threading.local()
+        self._uid = itertools.count()
+
+    def _request(self, method: str, path: str, tenant: str,
+                 body: bytes = b"") -> tuple[int, bytes]:
+        import http.client
+
+        from chubaofs_tpu.objectnode.auth import sign_v4
+
+        ak, sk = self.creds[tenant]
+        hdrs = sign_v4(method, path, "", {"host": self.addr}, ak, sk,
+                       payload=body)
+        conn = getattr(self._tls, "conn", None)
+        for attempt in (0, 1):  # one free retry on a stale keep-alive conn
+            if conn is None:
+                host, port = self.addr.rsplit(":", 1)
+                conn = http.client.HTTPConnection(  # obslint: per-tenant sigv4 S3 client; the rpc pool neither signs nor models per-tenant conns
+                    host, int(port), timeout=60)
+                self._tls.conn = conn
+            try:
+                conn.request(method, path, body=body or None, headers=hdrs)
+                resp = conn.getresponse()
+                return resp.status, resp.read()
+            except Exception:
+                conn.close()
+                conn = self._tls.conn = None
+                if attempt:
+                    raise
+        raise RuntimeError("unreachable")
+
+    def ensure_buckets(self) -> None:
+        for tenant in self.creds:
+            status, body = self._request("PUT", f"/cap-{tenant}", tenant)
+            if status != 200 and b"BucketAlreadyExists" not in body:
+                raise RuntimeError(
+                    f"bucket create for {tenant}: HTTP {status} {body[:200]}")
+
+    def blob_put(self, data: bytes, tenant: str | None = None) -> str:
+        path = f"/cap-{tenant}/o{next(self._uid)}"
+        status, body = self._request("PUT", path, tenant, body=data)
+        if status != 200:
+            raise RuntimeError(f"S3 PUT {path}: HTTP {status} {body[:120]}")
+        return path
+
+    def blob_get(self, token: str, tenant: str | None = None) -> bytes:
+        status, body = self._request("GET", token, tenant)
+        if status != 200:
+            raise RuntimeError(f"S3 GET {token}: HTTP {status} {body[:120]}")
+        return body
+
+    def blob_delete(self, token: str, tenant: str | None = None) -> None:
+        status, body = self._request("DELETE", token, tenant)
+        if status not in (200, 204):
+            raise RuntimeError(f"S3 DELETE {token}: HTTP {status} "
+                               f"{body[:120]}")
+
+    def fs(self):
+        return self.inner.fs() if self.inner is not None else None
+
+    def hot_fs(self):
+        return self.inner.hot_fs() if self.inner is not None else None
 
 
 # -- the open-loop executor ----------------------------------------------------
@@ -317,19 +400,19 @@ class Workload:
         with self._keylock(*k):
             if op.kind == "blob_put":
                 data = self._payload(op.size)
-                token = self.driver.blob_put(data)
+                token = self.driver.blob_put(data, tenant=op.tenant)
                 with self._lock:
                     old = self._blob.get(k)
                     self._blob[k] = (token, zlib.crc32(data))
                 if old:  # overwrite semantics: retire the displaced blob
-                    self.driver.blob_delete(old[0])
+                    self.driver.blob_delete(old[0], tenant=op.tenant)
                 return "ok"
             if op.kind == "blob_get":
                 with self._lock:
                     ent = self._blob.get(k)
                 if ent is None:
                     return "miss"  # nothing PUT under this key yet
-                data = self.driver.blob_get(ent[0])
+                data = self.driver.blob_get(ent[0], tenant=op.tenant)
                 if zlib.crc32(data) != ent[1]:
                     raise DataLossError(
                         f"blob {k} read back different bytes")
@@ -339,7 +422,7 @@ class Workload:
                     ent = self._blob.pop(k, None)
                 if ent is None:
                     return "miss"
-                self.driver.blob_delete(ent[0])
+                self.driver.blob_delete(ent[0], tenant=op.tenant)
                 return "ok"
             if op.kind in ("hot_write", "hot_read"):
                 return self._exec_hot(op, k)
@@ -710,9 +793,20 @@ def run_capacity(args, rebalance: bool, root: str, out_path: str) -> dict:
     master_extra = {}
     if rebalance:
         master_extra["rebalanceHotSecs"] = args.rebalance_secs
+    s3_mode = bool(getattr(args, "s3", False))
+    s3_creds: dict[str, tuple[str, str]] = {}
+    if s3_mode:
+        # deterministic per-tenant credentials, minted BEFORE the daemons
+        # boot so the objectnode's QoS plane can be told the tenant set up
+        # front — random create-time keys would all fold into the 'other'
+        # label and per-tenant shaping/SLOs could never engage
+        s3_creds = {t: (f"cap-ak-{t}", f"cap-sk-{t}")
+                    for t in (f"t{i}" for i in range(args.tenants))}
+        env.setdefault("CFS_QOS_TENANTS",
+                       ",".join(ak for ak, _ in s3_creds.values()))
     cluster = ProcCluster(root, masters=args.masters,
                           metanodes=args.metanodes, datanodes=args.datanodes,
-                          blobstore=True, env=env,
+                          blobstore=True, objectnode=s3_mode, env=env,
                           master_extra=master_extra or None)
     collector = spread = workload = None
     try:
@@ -730,6 +824,18 @@ def run_capacity(args, rebalance: bool, root: str, out_path: str) -> dict:
                         ramp=args.ramp, hot=hot_vol is not None)
         driver = RemoteDriver(cluster.master_addrs, [cluster.access_addr],
                               "cap_cold", hot_volume=hot_vol)
+        if s3_mode:
+            # the tenant mix lands on the S3 gateway instead of the SDK
+            # access client: per-tenant master users (the deterministic
+            # credentials the daemon env already declares), per-tenant
+            # buckets, sigv4 on every blob verb — the surface the
+            # CFS_QOS_* plane (armed via --daemon-env) shapes. Meta/hot
+            # verbs still ride the SDK driver underneath.
+            for t in plan["tenants"]:
+                ak, sk = s3_creds[t]
+                mc.create_user(f"cap-{t}", ak=ak, sk=sk)
+            driver = S3Driver(cluster.s3_addr, s3_creds, inner=driver)
+            driver.ensure_buckets()
         collector = Collector(out_path, console=console,
                               interval=args.interval)
         spread = SpreadMonitor(mc)
@@ -796,6 +902,11 @@ def main(argv=None) -> int:
                    default=env_int("CFS_CACHE_MB", 0),
                    help="arm the blobstore daemon's tiered read cache with "
                         "this memory budget (MiB); 0 = cold EC path only")
+    p.add_argument("--s3", action="store_true",
+                   help="drive the tenant mix at the objectnode S3 surface "
+                        "(per-tenant users + buckets + sigv4) instead of "
+                        "the SDK access client; combine with --daemon-env "
+                        "CFS_QOS_*=... to shape it")
     p.add_argument("--rebalance", action="store_true",
                    help="arm the master's hot-volume spreading sweep")
     p.add_argument("--rebalance-secs", type=float, default=2.0)

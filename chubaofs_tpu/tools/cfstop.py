@@ -19,6 +19,9 @@ one row per daemon target:
     device batch — "is the gateway feeding the chip?");
   * CACHE% — cache-plane hit ratio over the window (`cfs_cache_hits` /
     `cfs_cache_lookups` deltas; '-' when the target serves no cache);
+  * THR% — QoS throttled-request share over the window
+    (`cfs_objectnode_throttled` / `cfs_objectnode_requests` deltas; '-'
+    when the target saw no shaped requests);
   * REPAIRQ — repair tasks outstanding (`cfs_scheduler_tasks` gauge sum);
   * UP — seconds since the daemon's `cfs_boot_time_seconds` boot stamp. A
     boot stamp that MOVED between frames is a confirmed restart — the row
@@ -48,7 +51,7 @@ from chubaofs_tpu.utils.metrichist import (
 from chubaofs_tpu.utils.slo import FAILING, RANK
 
 COLUMNS = ("TARGET", "SLO", "UP", "PUT/S", "GET/S", "PUT99MS", "CONNS",
-           "BP/S", "LAG99", "CODEC/B", "CACHE%", "REPAIRQ", "ALERTS")
+           "BP/S", "LAG99", "CODEC/B", "CACHE%", "THR%", "REPAIRQ", "ALERTS")
 
 
 # -- scraping ------------------------------------------------------------------
@@ -199,6 +202,12 @@ def compute_row(target: str, prev: dict | None, cur: dict | None,
     lookups = _rate(prev, cur, "cfs_cache_lookups", 1.0)
     hits = _rate(prev, cur, "cfs_cache_hits", 1.0)
     row["cache_pct"] = round(100.0 * hits / lookups, 1) if lookups > 0 else None
+    # QoS throttled-request share over the window (ISSUE 14): what fraction
+    # of this gateway's requests the per-tenant plane turned away; '-' on
+    # targets that saw no shaped requests (plane unarmed, or not a gateway)
+    reqs = _rate(prev, cur, "cfs_objectnode_requests", 1.0)
+    thr = _rate(prev, cur, "cfs_objectnode_throttled", 1.0)
+    row["thr_pct"] = round(100.0 * thr / reqs, 1) if reqs > 0 else None
     return row
 
 
@@ -234,6 +243,7 @@ def render(rows: list[dict], errors: list[str] = ()) -> str:
               _cell(r.get("put99_ms")), _cell(r.get("conns")),
               _cell(r.get("bp_s")), _cell(r.get("lag99_ms")),
               _cell(r.get("codec_occ")), _cell(r.get("cache_pct")),
+              _cell(r.get("thr_pct")),
               _cell(r.get("repair_q")), _cell(r.get("alerts"))]
              for r in rows]
     widths = [max(len(COLUMNS[i]), max(len(row[i]) for row in cells))

@@ -34,7 +34,8 @@ from collections.abc import Callable, Iterator
 # files that ARE the sanctioned packet-serving layer — exempt from the
 # serving-model rules (racelint thread-per-conn, obslint hand-framed
 # sendall). ONE definition so the linters can't drift apart.
-PACKET_LAYER_PATHS = ("rpc/evloop.py", "proto/packet.py")
+PACKET_LAYER_PATHS = ("rpc/evloop.py", "rpc/httpevloop.py",
+                      "proto/packet.py")
 
 
 def package_root() -> str:

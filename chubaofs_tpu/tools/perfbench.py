@@ -621,6 +621,394 @@ def bench_concurrency(clients_axis: tuple = (64, 256, 1024),
     return out
 
 
+def _gw_driver(addr: str, url: str, n_socks: int, ops: int,
+               tolerate: int = 0) -> None:
+    """Subprocess body for the gateway benches' load generator: keep-alive
+    S3 GETs of one presigned URL over `n_socks` http.client connections,
+    one in-flight request per connection — OUT of the server's process for
+    the same reason as _conc_driver (an in-process driver measures the load
+    generator, not the serving model). Pure stdlib: the URL is presigned by
+    the parent, so the driver needs no signing code. `tolerate=1` accepts
+    throttle statuses (429/503) and reports per-status counts (the QoS
+    fairness bench's noisy tenant); otherwise any non-200 aborts the run.
+    Protocol: connect + warm every socket, print READY, block for GO, run,
+    print one JSON line {"lats": [...ms...], "statuses": {code: n}}."""
+    import http.client as _hc
+    import threading
+
+    host, port = addr.rsplit(":", 1)
+
+    def connect():
+        c = _hc.HTTPConnection(host, int(port), timeout=60)  # obslint: bench driver — one keep-alive conn PER simulated client IS the workload; pooling would defeat the A/B
+        c.connect()
+        return c
+
+    conns = [connect() for _ in range(n_socks)]
+    for c in conns:  # warm: conn registration, framer state, a real GET
+        c.request("GET", url, headers={"Host": addr})
+        r = c.getresponse()
+        r.read()
+    print("READY", flush=True)
+    sys.stdin.readline()  # GO
+    n_threads = max(1, min(8, n_socks))
+    chunks = [conns[t::n_threads] for t in range(n_threads)]
+    lats: list[list[float]] = [[] for _ in range(n_threads)]
+    statuses: list[dict] = [{} for _ in range(n_threads)]
+
+    def run(t: int) -> None:
+        mine, out, st = chunks[t], lats[t], statuses[t]
+        for _ in range(ops):
+            for i, c in enumerate(mine):
+                t0 = time.perf_counter()
+                try:
+                    c.request("GET", url, headers={"Host": addr})
+                    r = c.getresponse()
+                    r.read()
+                    status = r.status
+                except Exception:
+                    status = -1
+                    mine[i] = connect()  # server closed a throttled conn
+                out.append(time.perf_counter() - t0)
+                st[status] = st.get(status, 0) + 1
+                if status != 200 and not tolerate:
+                    raise RuntimeError(f"gateway driver got HTTP {status}")
+
+    threads = [threading.Thread(target=run, args=(t,), daemon=True)
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for c in conns:
+        c.close()
+    agg: dict = {}
+    for st in statuses:
+        for k, v in st.items():
+            agg[str(k)] = agg.get(str(k), 0) + v
+    print(json.dumps({"lats": [round(x * 1e3, 3) for ch in lats for x in ch],
+                      "statuses": agg}), flush=True)
+
+
+_GW_DRIVER_CMD = (
+    "import sys\n"
+    "from chubaofs_tpu.tools.perfbench import _gw_driver\n"
+    "_gw_driver(sys.argv[1], sys.argv[2], int(sys.argv[3]), int(sys.argv[4]),"
+    " int(sys.argv[5]))\n")
+
+
+def _paced_driver(addr: str, url: str, rate: float, duration: float,
+                  warm_s: float = 1.0) -> None:
+    """Subprocess body for the fairness bench's VICTIM: one keep-alive
+    connection, open-loop paced at `rate` req/s for `duration` seconds —
+    the tenant whose p99 the noisy neighbor must not wreck. The first
+    `warm_s` seconds still COUNT toward goodput (statuses) but are
+    excluded from the latency sample: phase start is when both drivers'
+    connection storms land and the server's lazy worker pool spawns, a
+    one-time transient that would otherwise own a small sample's p99.
+    Prints the same JSON line shape as _gw_driver."""
+    import http.client as _hc
+
+    host, port = addr.rsplit(":", 1)
+    c = _hc.HTTPConnection(host, int(port), timeout=60)  # obslint: bench driver — one keep-alive conn PER simulated client IS the workload; pooling would defeat the A/B
+    c.request("GET", url, headers={"Host": addr})
+    c.getresponse().read()
+    print("READY", flush=True)
+    sys.stdin.readline()
+    lats: list[float] = []
+    statuses: dict = {}
+    t0 = time.perf_counter()
+    n = 0
+    while True:
+        sched = t0 + n / rate
+        now = time.perf_counter()
+        if sched - now > 0:
+            time.sleep(sched - now)
+        if time.perf_counter() - t0 >= duration:
+            break
+        t1 = time.perf_counter()
+        try:
+            c.request("GET", url, headers={"Host": addr})
+            r = c.getresponse()
+            r.read()
+            status = r.status
+        except Exception:
+            status = -1
+            c = _hc.HTTPConnection(host, int(port), timeout=60)  # obslint: bench driver — one keep-alive conn PER simulated client IS the workload; pooling would defeat the A/B
+        if t1 - t0 >= warm_s:
+            lats.append(time.perf_counter() - t1)
+        statuses[str(status)] = statuses.get(str(status), 0) + 1
+        n += 1
+    print(json.dumps({"lats": [round(x * 1e3, 3) for x in lats],
+                      "statuses": statuses}), flush=True)
+
+
+_PACED_DRIVER_CMD = (
+    "import sys\n"
+    "from chubaofs_tpu.tools.perfbench import _paced_driver\n"
+    "_paced_driver(sys.argv[1], sys.argv[2], float(sys.argv[3]),"
+    " float(sys.argv[4]))\n")
+
+
+def _spawn_driver(cmd: str, argv: list) -> subprocess.Popen:
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen([sys.executable, "-c", cmd] + [str(a) for a in argv],
+                            stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                            env=env, text=True)
+
+
+def _drive(procs: list, label: str) -> list[dict]:
+    """READY/GO handshake + result collection for a set of driver procs."""
+    for p in procs:
+        if p.stdout.readline().strip() != "READY":
+            raise RuntimeError(f"{label} driver died during warm-up")
+    for p in procs:
+        p.stdin.write("GO\n")
+        p.stdin.flush()
+    outs = []
+    for p in procs:
+        line = p.stdout.readline()
+        if not line.strip():
+            raise RuntimeError(f"{label} driver died mid-run")
+        outs.append(json.loads(line))
+    for p in procs:
+        p.wait(timeout=30)
+    return outs
+
+
+def _p99(lats: list[float]) -> float:
+    lats = sorted(lats)
+    return lats[min(len(lats) - 1, int(0.99 * len(lats)))] if lats else 0.0
+
+
+class _S3Fixture:
+    """One FsCluster + ObjectNode the gateway benches serve: a bucket, a
+    small object, presign() for driver URLs, serve()/stop() to bring an
+    RPCServer up under the CURRENT CFS_EVLOOP_HTTP mode."""
+
+    AK, SK = "benchak", "benchsk"
+
+    def __init__(self, root: str, payload: int = 2048, qos=None):
+        from chubaofs_tpu.deploy import FsCluster
+        from chubaofs_tpu.objectnode.server import ObjectNode
+
+        self.cluster = FsCluster(root, n_nodes=3, blob_nodes=6, data_nodes=0)
+        self.node = ObjectNode(
+            self.cluster, users={self.AK: {"secret_key": self.SK,
+                                           "uid": "bench"}}, qos=qos)
+        self.users = {self.AK: self.SK}
+        self.srv = None
+        self._payload = payload
+
+    def serve(self):
+        from chubaofs_tpu.rpc.server import RPCServer
+
+        self.srv = RPCServer(self.node.router, metrics=False,
+                             module="objectnode").start()
+        return self.srv.addr
+
+    def put_object(self, bucket: str = "bench", key: str = "obj",
+                   ak: str | None = None, sk: str | None = None) -> None:
+        import http.client as _hc
+
+        from chubaofs_tpu.objectnode import auth as s3auth
+
+        ak, sk = ak or self.AK, sk or self.SK
+        host, port = self.srv.addr.rsplit(":", 1)
+        for method, path, body in ((("PUT", f"/{bucket}", b"")),
+                                   ("PUT", f"/{bucket}/{key}",
+                                    b"\xa5" * self._payload)):
+            hdrs = s3auth.sign_v4(method, path, "", {"host": self.srv.addr},
+                                  ak, sk, payload=body)
+            c = _hc.HTTPConnection(host, int(port))  # obslint: bench driver — one keep-alive conn PER simulated client IS the workload; pooling would defeat the A/B
+            c.request(method, path, body=body, headers=hdrs)
+            r = c.getresponse()
+            r.read()
+            c.close()
+            if r.status != 200:
+                raise RuntimeError(f"fixture {method} {path} -> {r.status}")
+
+    def presign(self, bucket: str = "bench", key: str = "obj",
+                ak: str | None = None, sk: str | None = None) -> str:
+        from chubaofs_tpu.objectnode import auth as s3auth
+
+        path = f"/{bucket}/{key}"
+        q = s3auth.presign_v4("GET", path, self.srv.addr, ak or self.AK,
+                              sk or self.SK)
+        return f"{path}?{q}"
+
+    def stop_server(self):
+        if self.srv is not None:
+            self.srv.stop()
+            self.srv = None
+
+    def close(self):
+        self.stop_server()
+        self.cluster.close()
+
+
+def bench_gateway(root: str, clients_axis: tuple = (64, 256, 1024),
+                  ops_per_client: int = 10, payload: int = 2048) -> dict:
+    """Gateway serving-model A/B (ISSUE 14): ops/s and p99 at 64/256/1024
+    keep-alive S3 client connections doing presigned GETs against a REAL
+    ObjectNode over a real FsCluster — evloop HTTP core vs the
+    CFS_EVLOOP_HTTP=0 ThreadingHTTPServer baseline, the bench_concurrency
+    shape ported to the HTTP plane. Drivers are subprocesses (own GIL);
+    the server is rebuilt per phase under the phase's serving mode; every
+    request must be HTTP 200. The headline number is FLATNESS: evloop
+    throughput at 1024c vs its own 64c value, where the threaded control
+    degrades under 1024 parked handler threads."""
+    fix = _S3Fixture(os.path.join(root, "gwbench"), payload=payload)
+    out: dict = {}
+    try:
+        def phase(mode: str, n_clients: int) -> tuple[float, float]:
+            prev = os.environ.get("CFS_EVLOOP_HTTP")
+            os.environ["CFS_EVLOOP_HTTP"] = "1" if mode == "evloop" else "0"
+            procs: list[subprocess.Popen] = []
+            try:
+                addr = fix.serve()
+                if not out:  # first phase creates the bucket + object
+                    fix.put_object()
+                url = fix.presign()
+                n_procs = max(1, min(4, n_clients // 16))
+                per = n_clients // n_procs
+                procs = [_spawn_driver(
+                    _GW_DRIVER_CMD, [addr, url, per, ops_per_client, 0])
+                    for _ in range(n_procs)]
+                t0 = time.perf_counter()
+                outs = _drive(procs, f"gateway {mode} {n_clients}c")
+                dt = time.perf_counter() - t0
+                lats = [x for o in outs for x in o["lats"]]
+                bad = {k: v for o in outs for k, v in o["statuses"].items()
+                       if k != "200"}
+                if bad or len(lats) != n_procs * per * ops_per_client:
+                    raise RuntimeError(
+                        f"gateway driver anomalies ({mode}, {n_clients}c): "
+                        f"bad={bad} n={len(lats)}")
+                return len(lats) / dt, _p99(lats)
+            finally:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                fix.stop_server()
+                if prev is None:
+                    os.environ.pop("CFS_EVLOOP_HTTP", None)
+                else:
+                    os.environ["CFS_EVLOOP_HTTP"] = prev
+
+        for n in clients_axis:
+            for mode in ("threads", "evloop"):
+                ops, p99 = phase(mode, n)
+                out[f"gw_ops_{n}c_{mode}"] = round(ops, 1)
+                out[f"gw_p99_ms_{n}c_{mode}"] = round(p99, 2)
+                log(f"  gateway {n}c {mode}: {out[f'gw_ops_{n}c_{mode}']} "
+                    f"ops/s, p99 {out[f'gw_p99_ms_{n}c_{mode}']} ms")
+            out[f"gw_speedup_{n}c"] = round(
+                out[f"gw_ops_{n}c_evloop"]
+                / max(0.001, out[f"gw_ops_{n}c_threads"]), 2)
+        lo, hi = clients_axis[0], clients_axis[-1]
+        out["gw_flatness_evloop"] = round(
+            out[f"gw_ops_{hi}c_evloop"]
+            / max(0.001, out[f"gw_ops_{lo}c_evloop"]), 2)
+        out["gw_flatness_threads"] = round(
+            out[f"gw_ops_{hi}c_threads"]
+            / max(0.001, out[f"gw_ops_{lo}c_threads"]), 2)
+        log(f"  gateway flatness {lo}c->{hi}c: evloop "
+            f"{out['gw_flatness_evloop']}x vs threads "
+            f"{out['gw_flatness_threads']}x")
+    finally:
+        fix.close()
+    return out
+
+
+def bench_qos_fairness(root: str, parent_rps: float = 50.0,
+                       victim_rps: float = 15.0, duration: float = 4.0,
+                       noisy_socks: int = 24) -> dict:
+    """Multi-tenant fairness A/B (ISSUE 14): a victim tenant paced at
+    victim_rps measures its GET p99 SOLO, then again while a noisy tenant
+    offers ~10x the victim's load through `noisy_socks` tight-loop
+    connections — with the QoS plane armed (shared parent at parent_rps,
+    deficit-fair dequeue, bounded queue wait). The noisy tenant must be
+    CAPPED (throttle counters nonzero, 429/503 in its status mix) while
+    the victim's p99 stays within a small factor of its solo baseline and
+    its goodput holds."""
+    from chubaofs_tpu.utils.qos import QosPlane
+
+    ak_n, sk_n = "noisyak", "noisysk"
+    # a saturated tenant's fair-queue waiters PARK a dispatch worker for up
+    # to queue_ms each; the pool must be sized above the shaped concurrency
+    # or the victim waits for a WORKER, not for tokens (the reserve bucket
+    # can only protect admission, not a starved pool). Set BEFORE the plane
+    # is built: FairLimiter bounds its waiter herd to half this pool.
+    prev_workers = os.environ.get("CFS_EVLOOP_WORKERS")
+    os.environ["CFS_EVLOOP_WORKERS"] = str(max(64, noisy_socks * 2))
+    qos = QosPlane(("noisyak", "benchak"), rps=parent_rps,
+                   tenant_min_rps=victim_rps * 2, queue_ms=50.0,
+                   queue_len=16)
+    fix = _S3Fixture(os.path.join(root, "qosbench"), payload=2048, qos=qos)
+    fix.node.users[ak_n] = {"secret_key": sk_n, "uid": "noisy"}
+    out: dict = {}
+    try:
+        addr = fix.serve()
+        fix.put_object()  # victim's bucket (benchak owns it)
+        # noisy tenant gets its own bucket/object so ACLs stay out of the way
+        fix.put_object(bucket="noisy", key="obj", ak=ak_n, sk=sk_n)
+        v_url = fix.presign()
+        n_url = fix.presign(bucket="noisy", key="obj", ak=ak_n, sk=sk_n)
+
+        def victim_phase(with_noise: bool) -> tuple[float, float, dict]:
+            procs = [_spawn_driver(_PACED_DRIVER_CMD,
+                                   [addr, v_url, victim_rps, duration])]
+            if with_noise:
+                procs.append(_spawn_driver(
+                    _GW_DRIVER_CMD,
+                    [addr, n_url, noisy_socks,
+                     max(4, int(victim_rps * 10 * duration / noisy_socks)),
+                     1]))
+            try:
+                outs = _drive(procs, "fairness")
+            finally:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+            vic = outs[0]
+            noisy = outs[1]["statuses"] if with_noise else {}
+            ok = vic["statuses"].get("200", 0)
+            goodput = ok / max(duration, 1e-9)
+            return _p99(vic["lats"]), goodput, noisy
+
+        p99_solo, goodput_solo, _ = victim_phase(False)
+        p99_mixed, goodput_mixed, noisy_st = victim_phase(True)
+        thr = sum(v for k, v in noisy_st.items() if k in ("429", "503", "-1"))
+        served = noisy_st.get("200", 0)
+        out.update({
+            "qos_victim_p99_solo_ms": round(p99_solo, 2),
+            "qos_victim_p99_mixed_ms": round(p99_mixed, 2),
+            "qos_victim_p99_ratio": round(p99_mixed / max(p99_solo, 1e-9), 2),
+            "qos_victim_goodput_solo": round(goodput_solo, 1),
+            "qos_victim_goodput_mixed": round(goodput_mixed, 1),
+            "qos_victim_goodput_ratio": round(
+                goodput_mixed / max(goodput_solo, 1e-9), 2),
+            "qos_noisy_served": served,
+            "qos_noisy_throttled": thr,
+        })
+        log(f"  qos fairness: victim p99 {out['qos_victim_p99_solo_ms']} -> "
+            f"{out['qos_victim_p99_mixed_ms']} ms "
+            f"(x{out['qos_victim_p99_ratio']}), goodput ratio "
+            f"{out['qos_victim_goodput_ratio']}, noisy served {served} / "
+            f"throttled {thr}")
+    finally:
+        if prev_workers is None:
+            os.environ.pop("CFS_EVLOOP_WORKERS", None)
+        else:
+            os.environ["CFS_EVLOOP_WORKERS"] = prev_workers
+        fix.close()
+        qos.close()
+    return out
+
+
 def bench_capacity(root: str, duration: float = 3.5, rate: float = 20.0,
                    seed: int = 7, interval: float = 0.4,
                    tenants: int = 3) -> dict:
@@ -895,6 +1283,19 @@ def run(root: str, n_files: int = 600, n_clients: int = 4,
     else:  # smoke invocations get a smoke-size zipf sweep
         cfg.update(bench_cache_zipf(os.path.join(root, "cachebench"),
                                     objects=12, obj_kb=32, gets=80))
+    # the gateway phases run AFTER the ProcCluster phases for the same
+    # reason as bench_concurrency/bench_cache_zipf (the PR-8/PR-12 floor-
+    # deflation lesson): the 1024-conn sweep saturates every core, and a
+    # throttle-recovering host would deflate the md/stream floors; both
+    # arms of each A/B are phase-internal, so position costs nothing
+    log("gateway serving-model sweep (evloop HTTP vs threaded A/B)...")
+    if n_files >= 300:
+        cfg.update(bench_gateway(os.path.join(root, "gwroot")))
+    else:
+        cfg.update(bench_gateway(os.path.join(root, "gwroot"),
+                                 clients_axis=(32, 128), ops_per_client=6))
+    log("gateway QoS fairness (noisy tenant vs victim tenant)...")
+    cfg.update(bench_qos_fairness(os.path.join(root, "qosroot")))
     _dump_metrics(cfg)
     return cfg
 

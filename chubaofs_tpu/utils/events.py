@@ -77,6 +77,7 @@ EVENT_TYPES = (
     "failpoint_disarmed",   # a failpoint was disarmed
     "alert_firing",         # an alert rule started firing
     "alert_resolved",       # a firing alert cleared
+    "qos_throttle",         # gateway QoS throttled a tenant (episode, 1/s)
     "bench_tick",           # perfbench events-overhead smoke traffic
 )
 

@@ -230,12 +230,22 @@ def parse_key(key: str) -> tuple[str, dict[str, str]]:
     return name, labels
 
 
-def family_sum(metrics: dict[str, float], family: str) -> float:
+def family_sum(metrics: dict[str, float], family: str,
+               label_in: tuple = ()) -> float:
     """Sum one family's value across its label sets (exact name match) —
     the shared flat-series aggregator slo.py and cfs-top both use, so the
     health plane and the dashboard can never disagree on what a counter
-    family's total means."""
-    return sum(v for k, v in metrics.items() if parse_key(k)[0] == family)
+    family's total means. `label_in` = (label_key, (allowed values...))
+    restricts the sum to matching series — the per-tenant SLO slice."""
+    if not label_in:
+        return sum(v for k, v in metrics.items() if parse_key(k)[0] == family)
+    lk, allowed = label_in
+    total = 0.0
+    for k, v in metrics.items():
+        name, labels = parse_key(k)
+        if name == family and labels.get(lk) in allowed:
+            total += v
+    return total
 
 
 def family_of(key: str) -> tuple[str, str]:

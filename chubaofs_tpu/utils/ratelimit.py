@@ -47,6 +47,29 @@ class TokenBucket:
                 return True
             return False
 
+    def debit(self, n: float = 1.0) -> None:
+        """Post-hoc charge: subtract n tokens, allowing the balance to go
+        NEGATIVE — the bandwidth-shaping pattern for response bytes whose
+        size is only known after the handler ran (a GET's body). Future
+        acquires wait until the debt refills; _refill_locked pays it down
+        at the configured rate."""
+        if self.rate <= 0:
+            return
+        with self._lock:
+            self._refill_locked(time.monotonic())
+            self._tokens -= n
+
+    def wait_time(self, n: float = 1.0) -> float:
+        """Seconds until n tokens COULD be available (0 when they already
+        are) — the Retry-After estimate; no tokens are taken."""
+        if self.rate <= 0:
+            return 0.0
+        with self._lock:
+            self._refill_locked(time.monotonic())
+            if self._tokens >= n:
+                return 0.0
+            return (n - self._tokens) / self.rate
+
     def acquire(self, n: float = 1.0, timeout: float | None = None) -> bool:
         """Take n tokens, sleeping while they accrue; False on timeout."""
         if self.rate <= 0:

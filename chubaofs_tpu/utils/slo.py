@@ -55,9 +55,9 @@ class SLO:
     # error_ratio denominator (a histogram family); counter_ratio
     # denominator (a plain counter family)
     ops_family: str = ""
-    # gauge_sum label restriction: (label_key, (allowed values...)) — e.g.
-    # a task inventory carries finished/failed series that are history, not
-    # backlog; only the live states count toward the objective
+    # label restriction: (label_key, (allowed values...)) — gauge_sum uses
+    # it to keep live task states only, counter_ratio to slice BOTH
+    # families down to one tenant's series (the per-tenant QoS SLOs)
     label_in: tuple = ()
     description: str = ""
 
@@ -76,11 +76,33 @@ def _env_n(name: str, default: int) -> int:
         return default
 
 
+# dynamic objective providers (name -> zero-arg callable returning [SLO]):
+# subsystems whose objectives exist only when configured — the QoS plane's
+# per-tenant throttle ratios — register here at arm time and unregister at
+# teardown, and every /health evaluation picks them up live
+_slo_providers: dict = {}
+
+
+def register_slo_provider(name: str, fn) -> None:
+    _slo_providers[name] = fn
+
+
+def unregister_slo_provider(name: str) -> None:
+    _slo_providers.pop(name, None)
+
+
 def default_slos() -> list[SLO]:
     """The stock objectives, thresholds from env at call time. Families
     missing on a role (no access layer on a metanode) evaluate to None and
     never breach — one spec set serves every daemon."""
     err = _env_f("CFS_SLO_ERR_RATIO", 0.01)
+    out = _base_slos(err)
+    for fn in list(_slo_providers.values()):
+        out.extend(fn())
+    return out
+
+
+def _base_slos(err: float) -> list[SLO]:
     return [
         SLO("put_p99", "hist_p99_ms", "cfs_access_put",
             _env_f("CFS_SLO_PUT_P99_MS", 2000.0),
@@ -113,14 +135,16 @@ def default_slos() -> list[SLO]:
 # -- per-window evaluators -----------------------------------------------------
 
 
-def _restart_delta(first: dict, last: dict, family: str) -> float:
+def _restart_delta(first: dict, last: dict, family: str,
+                   label_in: tuple = ()) -> float:
     """Counter-family window delta under the restart contract shared with
     metrichist.rates() / hist_delta / cfs-stat: a total that went DOWN
     means the daemon restarted, and the post-restart total IS the delta —
     clamping to zero would read a restarting-and-erroring daemon as clean
     exactly when it most needs watching."""
-    d = family_sum(last, family) - family_sum(first, family)
-    return family_sum(last, family) if d < 0 else d
+    end = family_sum(last, family, label_in)
+    d = end - family_sum(first, family, label_in)
+    return end if d < 0 else d
 
 
 def _eval_window(slo: SLO, window: list[dict],
@@ -155,11 +179,12 @@ def _eval_window(slo: SLO, window: list[dict],
         return errs / ops
     if slo.kind == "counter_ratio":
         # two plain counter families, numerator over denominator (the cache
-        # miss-ratio shape); same restart contract as error_ratio
+        # miss-ratio shape); same restart contract as error_ratio. label_in
+        # slices BOTH families (per-tenant QoS throttle ratios)
         if len(window) < 2:
             return None
-        num = _restart_delta(first, last, slo.family)
-        den = _restart_delta(first, last, slo.ops_family)
+        num = _restart_delta(first, last, slo.family, slo.label_in)
+        den = _restart_delta(first, last, slo.ops_family, slo.label_in)
         if den <= 0:
             return None  # no lookups in the window: a quiet cache is healthy
         return num / den

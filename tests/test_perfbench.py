@@ -142,3 +142,33 @@ def test_concurrency_bench_smoke_floor():
     assert out["conc_ops_16c_threads"] > 0, out
     assert out["conc_p99_ms_16c_evloop"] > 0, out
     assert out["conc_speedup_16c"] > 0, out
+
+
+def test_gateway_bench_smoke_floor(tmp_path):
+    """Tier-1 gateway-serving gate (ISSUE 14 satellite): the HTTP A/B at
+    smoke size must serve every presigned S3 GET of BOTH serving modes
+    with HTTP 200 (the phase raises on any anomaly) and report sane
+    rates. Speedup/flatness floors live in PERF.md, not CI (co-tenant
+    noise) — correctness under keep-alive fan-in is what gates here."""
+    from chubaofs_tpu.tools.perfbench import bench_gateway
+
+    out = bench_gateway(str(tmp_path), clients_axis=(16,), ops_per_client=4)
+    assert out["gw_ops_16c_evloop"] > 0, out
+    assert out["gw_ops_16c_threads"] > 0, out
+    assert out["gw_p99_ms_16c_evloop"] > 0, out
+    assert out["gw_speedup_16c"] > 0, out
+
+
+def test_qos_fairness_bench_smoke_floor(tmp_path):
+    """Tier-1 fairness gate (ISSUE 14): with the QoS plane armed, the
+    ~10x noisy tenant must be CAPPED (throttle counters nonzero) while
+    the victim's goodput holds — the two correctness halves of the
+    fairness claim. The p99 ratio is reported, not floored, for the same
+    co-tenant-noise reason as every other perf number."""
+    from chubaofs_tpu.tools.perfbench import bench_qos_fairness
+
+    out = bench_qos_fairness(str(tmp_path), duration=2.5)
+    assert out["qos_noisy_throttled"] > 0, out
+    assert out["qos_noisy_served"] > 0, out
+    assert out["qos_victim_goodput_ratio"] >= 0.7, out
+    assert out["qos_victim_p99_mixed_ms"] > 0, out
