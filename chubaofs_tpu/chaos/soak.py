@@ -535,6 +535,306 @@ def run_kill_soak(root: str, seed: int, n_nodes: int = 9,
         c.close()
 
 
+def run_meta_split_soak(root: str, seed: int, metanodes: int = 5,
+                        dirs: int = 8, seed_files: int = 12,
+                        creator_threads: int = 3, files_per_thread: int = 4000,
+                        kill_delay_s: tuple = (0.05, 0.4),
+                        settle_timeout_s: float = 120.0) -> dict:
+    """Metadata scale-out chaos soak (ISSUE 15): crash-restart a metanode
+    MID-SPLIT and MID-MIGRATION under live create load, over real daemon
+    processes (ProcCluster — SIGKILL is the fault, WAL recovery + the
+    master's resume/heal sweeps are the cure).
+
+    Phases:
+      1. seed a directory-heavy namespace (dirs interleaved with files so
+         the median split balances directories);
+      2. start creator threads (every ACKED create lands in a ledger);
+      3. trigger a mid-range LOAD SPLIT of the dirs-heavy partition and,
+         after a seeded delay, SIGKILL a metanode hosting it; respawn it;
+         the split must finish — either the synchronous call won the race
+         or the master's resume sweep drives it from the partition's
+         replicated freeze record (heartbeat split reports);
+      4. trigger a cross-metanode MIGRATION (rebalance_meta moves the
+         hottest partition's replica to the spare metanode) and SIGKILL
+         another metanode mid-dance; respawn; the master's
+         ensure_replica_counts sweep heals any partial move;
+      5. verify: ZERO created-file loss (every acked path stats and its
+         dentry appears exactly once), NO double-owned inode (per-leader
+         namespace dumps: every ino in exactly one partition, inside its
+         view range), membership healed (3 peers per partition), and the
+         kill timeline is visible via meta_split / meta_migrate events on
+         the master journal (freeze -> commit -> complete causally
+         ordered around the kill stamps).
+
+    Raises SoakFailure on any violation; returns stats + the timeline."""
+    import json as _json
+    import threading
+
+    from chubaofs_tpu.master.api_service import MasterClient
+    from chubaofs_tpu.meta.service import RemoteMetaNode
+    from chubaofs_tpu.sdk.cluster import RemoteCluster
+    from chubaofs_tpu.testing.harness import ProcCluster
+    from chubaofs_tpu.tools.cfsstat import scrape
+
+    rnd = random.Random(seed)
+    vol = "soakvol"
+    cluster = ProcCluster(root, masters=1, metanodes=metanodes, datanodes=0)
+    stats = {"seed": seed, "creates_acked": 0, "creates_failed": 0,
+             "kills": []}
+    try:
+        mc = cluster.client_master()
+        mc.create_volume(vol, cold=True)
+        fs0 = cluster.fs(vol)
+        dir_inos = []
+        for d in range(dirs):
+            dir_inos.append(fs0.mkdirs(f"/d{d}"))
+            for i in range(seed_files):
+                fs0.create(f"/d{d}/seed{i}")
+        ledger: list[str] = [f"/d{d}/seed{i}" for d in range(dirs)
+                             for i in range(seed_files)]
+        ledger_lock = threading.Lock()
+        stop = threading.Event()
+
+        def creator(t: int):
+            fs = cluster.fs(vol)
+            i = 0
+            # runs until phase 5 stops it (the migrate phase needs LIVE
+            # load in the heartbeat windows); files_per_thread is the
+            # per-thread runaway cap bounding the ledger on a slow host
+            while not stop.is_set() and i < files_per_thread:
+                path = f"/d{(t + i) % dirs}/t{t}_f{i}"
+                i += 1
+                try:
+                    fs.create(path)
+                except Exception:
+                    # NOT acked: never counted against data loss (the
+                    # run_soak contract); a metanode kill can legitimately
+                    # fail an op mid-election past the retry window
+                    with ledger_lock:
+                        stats["creates_failed"] += 1
+                    continue
+                with ledger_lock:
+                    ledger.append(path)
+                    stats["creates_acked"] += 1
+
+        threads = [threading.Thread(target=creator, args=(t,), daemon=True)
+                   for t in range(creator_threads)]
+        for t in threads:
+            t.start()
+
+        def mps():
+            return sorted(mc.meta_partitions(vol), key=lambda m: m["start"])
+
+        def frozen_reported() -> bool:
+            return any(n.get("splits")
+                       for n in mc.get_cluster()["nodes"]
+                       if n["kind"] == "meta")
+
+        def await_settled(want_parts: int, what: str):
+            deadline = time.monotonic() + settle_timeout_s
+            last_view, last_frozen = None, None
+            while time.monotonic() < deadline:
+                try:
+                    view = mps()
+                    last_view, last_frozen = view, frozen_reported()
+                    if len(view) >= want_parts and not last_frozen \
+                            and all(len(m["peers"]) == 3 for m in view):
+                        return view
+                except Exception:
+                    pass  # master mid-failover: poll again
+                time.sleep(0.5)
+            # diagnose from the LAST GOOD poll: the master may still be
+            # flaky here, and a fresh RPC raising would replace this
+            # SoakFailure with an unrelated ConnectionError
+            raise SoakFailure(
+                f"meta-split soak seed {seed}: {what} did not settle in "
+                f"{settle_timeout_s:.0f}s (view: {last_view}, "
+                f"frozen={last_frozen})")
+
+        def kill_and_respawn(name: str, phase: str,
+                             delay_range: tuple) -> None:
+            delay = rnd.uniform(*delay_range)
+            time.sleep(delay)
+            t_kill = time.time()
+            cluster.kill(name)
+            stats["kills"].append({"phase": phase, "node": name,
+                                   "delay_s": round(delay, 3),
+                                   "ts": t_kill})
+            time.sleep(rnd.uniform(0.2, 0.6))
+            nid = int(name.replace("metanode", ""))
+            cluster.spawn(name, cluster.metanode_cfg(nid))
+
+        # -- phase 3: kill mid-split --------------------------------------
+        target = mps()[0]
+        peers = list(target["peers"])
+        victim_id = rnd.choice(peers)
+        split_res: dict = {}
+
+        def do_split():
+            try:
+                split_res["new_pid"] = mc.split_meta_partition(
+                    vol, target["partition_id"])["new_pid"]
+            except Exception as e:  # the resume sweep owns completion
+                split_res["error"] = str(e)
+
+        splitter = threading.Thread(target=do_split, daemon=True)
+        splitter.start()
+        kill_and_respawn(f"metanode{victim_id}", "split", kill_delay_s)
+        splitter.join(timeout=60)
+        # a TAIL split chains a cursor split: expect >= 3 partitions
+        view = await_settled(3, "split")
+        stats["partitions_after_split"] = len(view)
+
+        # -- phase 4: kill mid-migration ----------------------------------
+        # make one partition's load dominate so rebalance_meta picks it,
+        # then race the membership dance against a kill of a SURVIVOR peer
+        mig_res: dict = {}
+
+        def do_migrate():
+            try:
+                mig_res["moved"] = mc.rebalance_meta(
+                    factor=0.5, max_moves=1)["moved"]
+            except Exception as e:
+                mig_res["error"] = str(e)
+
+        migrator = threading.Thread(target=do_migrate, daemon=True)
+        migrator.start()
+        view = mps()
+        peers_now = {p for m in view for p in m["peers"]}
+        victim2 = rnd.choice(sorted(peers_now))
+        kill_and_respawn(f"metanode{victim2}", "migrate", kill_delay_s)
+        migrator.join(timeout=90)
+        stats["migrate_moved"] = mig_res.get("moved", 0)
+        stats["migrate_error"] = mig_res.get("error", "")
+        view = await_settled(len(view), "migration heal")
+        # the killed-mid-dance call may have moved nothing (raced the kill
+        # or an empty load window): the migration half must still be
+        # EXERCISED, so retry on the healed cluster until a replica moves
+        # (creators keep the leaders' load windows nonzero)
+        last_loads = None
+        for _ in range(20):
+            if stats["migrate_moved"]:
+                break
+            time.sleep(1.5)  # a heartbeat window of load accumulates
+            try:
+                res = mc.rebalance_meta(factor=0.5, max_moves=1)
+                stats["migrate_moved"] = res["moved"]
+                last_loads = res.get("loads")
+            except Exception:
+                continue
+        if not stats["migrate_moved"]:
+            # diagnose from the LAST GOOD attempt: a fresh RPC here could
+            # raise against a still-flaky master and replace this
+            # SoakFailure with an unrelated transport error
+            raise SoakFailure(
+                f"meta-split soak seed {seed}: rebalance_meta never moved "
+                f"a replica (loads {last_loads})")
+        view = await_settled(len(view), "post-retry migration heal")
+
+        # -- phase 5: verification ----------------------------------------
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+        with ledger_lock:
+            acked = list(ledger)
+
+        # zero created-file loss + exactly-once dentries
+        census = RemoteCluster(cluster.master_addrs).client(vol)
+        by_dir: dict[int, list[str]] = {}
+        for path in acked:
+            d = int(path.split("/")[1][1:])
+            by_dir.setdefault(d, []).append(path.rsplit("/", 1)[1])
+        for d, names in by_dir.items():
+            listed = census.readdir(f"/d{d}")
+            if len(listed) != len(set(listed)):
+                raise SoakFailure(
+                    f"meta-split soak seed {seed}: duplicate dentries "
+                    f"in /d{d}")
+            missing = set(names) - set(listed)
+            if missing:
+                raise SoakFailure(
+                    f"meta-split soak seed {seed}: {len(missing)} acked "
+                    f"file(s) LOST in /d{d}: {sorted(missing)[:5]}")
+            for name in names[:: max(1, len(names) // 20)]:
+                census.stat(f"/d{d}/{name}")  # resolvable end to end
+
+        # no double-owned inode: per-leader namespace dumps
+        view = mps()
+        handles = {n["node_id"]: RemoteMetaNode(n["addr"])
+                   for n in mc.get_cluster()["nodes"]
+                   if n["kind"] == "meta" and n["addr"]}
+        owner: dict[int, int] = {}
+        try:
+            for m in view:
+                pid = m["partition_id"]
+                end = m["end"] if m["end"] > 0 else (1 << 63)
+                dump = None
+                for _ in range(10):  # a fresh election may be settling
+                    for p in m["peers"]:
+                        try:
+                            dump = handles[p].dump_namespace(pid)
+                            break
+                        except Exception:
+                            continue
+                    if dump is not None:
+                        break
+                    time.sleep(0.5)
+                if dump is None:
+                    raise SoakFailure(
+                        f"meta-split soak seed {seed}: no leader dump for "
+                        f"partition {pid}")
+                for inode in dump["inodes"]:
+                    ino = inode.ino
+                    if not (m["start"] <= ino < end):
+                        raise SoakFailure(
+                            f"meta-split soak seed {seed}: partition {pid} "
+                            f"holds out-of-range ino {ino} "
+                            f"[{m['start']},{end})")
+                    if ino in owner:
+                        raise SoakFailure(
+                            f"meta-split soak seed {seed}: ino {ino} "
+                            f"DOUBLE-OWNED by partitions {owner[ino]} "
+                            f"and {pid}")
+                    owner[ino] = pid
+        finally:
+            for h in handles.values():
+                h.close()
+        stats["inodes_census"] = len(owner)
+
+        # the kill timeline: meta_split freeze -> commit -> complete and
+        # meta_migrate add_peer/remove_peer on the master journal
+        evs = _json.loads(scrape(cluster.master_addrs[0],
+                                 "/events?n=2000"))["events"]
+        split_phases = [e["detail"].get("phase") for e in evs
+                        if e["type"] == "meta_split"]
+        for phase in ("freeze", "commit", "complete"):
+            if phase not in split_phases:
+                raise SoakFailure(
+                    f"meta-split soak seed {seed}: no meta_split "
+                    f"phase={phase} event on the master journal "
+                    f"(saw {split_phases})")
+        if stats["migrate_moved"]:
+            mig_phases = [e["detail"].get("phase") for e in evs
+                          if e["type"] == "meta_migrate"]
+            for phase in ("add_peer", "remove_peer"):
+                if phase not in mig_phases:
+                    raise SoakFailure(
+                        f"meta-split soak seed {seed}: no meta_migrate "
+                        f"phase={phase} event (saw {mig_phases})")
+        timeline = [{"t": e["ts"], "type": e["type"], "entity": e["entity"],
+                     "phase": e["detail"].get("phase", "")}
+                    for e in evs if e["type"] in ("meta_split",
+                                                  "meta_migrate")]
+        if stats["creates_acked"] == 0:
+            raise SoakFailure(
+                f"meta-split soak seed {seed}: zero creates acked under "
+                f"chaos — the soak tested nothing")
+        return {"plan": "meta_split", "ok": True, "timeline": timeline,
+                "partitions": len(view), **stats}
+    finally:
+        cluster.close()
+
+
 def run_cache_soak(root: str, seed: int, rounds: int = 4, objects: int = 12,
                    obj_kb: int = 32, gets_per_round: int = 24,
                    invalidate_delay: float = 0.05, promote_hits: int = 4,

@@ -187,6 +187,18 @@ class CLI:
     def metanode_decommission(self, args):
         self._emit(self.mc.decommission_node(args.id, "meta"))
 
+    def metanode_rebalance(self, args):
+        """One hot-meta-partition migration sweep (heartbeat-load driven)."""
+        res = self.mc.rebalance_meta(factor=args.factor,
+                                     max_moves=args.max_moves)
+        if self.as_json:
+            return self._emit(res)
+        print(f"moved {res['moved']} replica(s)", file=self.out)
+        rows = [{"id": nid, "window_ops": int(load)}
+                for nid, load in sorted(res["loads"].items(),
+                                        key=lambda kv: int(kv[0]))]
+        table(rows, ["id", "window_ops"], self.out)
+
     def datanode_decommission(self, args):
         self._emit(self.mc.decommission_node(args.id, "data"))
 
@@ -254,7 +266,7 @@ _cfs_cli() {
   case "$prev" in
     cluster) verbs="info topology" ;;
     vol) verbs="create list info delete" ;;
-    metanode|datanode) verbs="list decommission" ;;
+    metanode|datanode) verbs="list decommission rebalance" ;;
     metapartition) verbs="list" ;;
     datapartition) verbs="list create" ;;
     user) verbs="create delete info list perm" ;;
@@ -320,6 +332,10 @@ def build_parser() -> argparse.ArgumentParser:
     md = mn.add_parser("decommission")
     md.add_argument("id", type=int)
     md.set_defaults(fn="metanode_decommission")
+    mrb = mn.add_parser("rebalance")
+    mrb.add_argument("--factor", type=float, default=1.5)
+    mrb.add_argument("--max-moves", type=int, default=1)
+    mrb.set_defaults(fn="metanode_rebalance")
     dn = sub.add_parser("datanode").add_subparsers(dest="verb", required=True)
     dn.add_parser("list").set_defaults(fn="datanode_list")
     rb = dn.add_parser("rebalance")

@@ -200,6 +200,12 @@ class MasterDaemon(_Daemon):
         # capacity harness triggers it via /dataNode/rebalanceHot instead)
         self.rebalance_hot_secs = float(cfg.get("rebalanceHotSecs", 0))
         self.rebalance_hot_factor = float(cfg.get("rebalanceHotFactor", 1.5))
+        # metadata scale-out knobs (ISSUE 15): rebalanceMetaSecs > 0 runs a
+        # rebalance_meta sweep on its own cadence (0/absent = off; the
+        # operator triggers /metaPartition/rebalance instead); metaSplitOps
+        # overrides the CFS_META_SPLIT_OPS load-split threshold
+        self.rebalance_meta_secs = float(cfg.get("rebalanceMetaSecs", 0))
+        self.rebalance_meta_factor = float(cfg.get("rebalanceMetaFactor", 1.5))
         self.net = _make_net(self.node_id, raft_peers, cfg)
         self.raft = MultiRaft(self.node_id, self.net, wal_dir=cfg.get("walDir"),
                               snapshot_every=512)
@@ -210,6 +216,9 @@ class MasterDaemon(_Daemon):
         self.master.datanode_hook = self._data_hook
         self.master.raft_config_hook = self._raft_config_hook
         self.master.remove_partition_hook = self._remove_partition_hook
+        self.master.meta_op_hook = self._meta_op_hook
+        if "metaSplitOps" in cfg:
+            self.master.meta_split_ops = float(cfg["metaSplitOps"] or 0)
         svc_secret = cfg.get("serviceSecret")
         ticket_key = cfg.get("adminTicketKey")  # b64 authnode service key
         if ticket_key:
@@ -231,6 +240,9 @@ class MasterDaemon(_Daemon):
         if self.rebalance_hot_secs > 0:
             self._every(self.rebalance_hot_secs, self._rebalance_hot,
                         f"master{self.node_id}-rebalance")
+        if self.rebalance_meta_secs > 0:
+            self._every(self.rebalance_meta_secs, self._rebalance_meta,
+                        f"master{self.node_id}-metarebalance")
 
     def _rebalance_hot(self):
         if self.master.is_leader:
@@ -238,6 +250,14 @@ class MasterDaemon(_Daemon):
             if moved:
                 _log(f"master{self.node_id}",
                      f"rebalance_hot moved {moved} replica(s)")
+
+    def _rebalance_meta(self):
+        if self.master.is_leader:
+            moved = self.master.rebalance_meta(
+                factor=self.rebalance_meta_factor)
+            if moved:
+                _log(f"master{self.node_id}",
+                     f"rebalance_meta moved {moved} replica(s)")
 
     # -- admin tasks to nodes (master/cluster_task.go analog) ------------------
 
@@ -363,6 +383,45 @@ class MasterDaemon(_Daemon):
             time.sleep(0.3)
         raise RuntimeError(f"raft config {action}({node_id}) on {pid}: {last}")
 
+    def _meta_op_hook(self, pid: int, peers: list[int], op: str, args: dict,
+                      read: bool = False):
+        """Run one metanode op on a partition's raft leader over the wire
+        (the split orchestrator's plumbing): walk the candidate peers
+        following not-leader hints, skipping replicas that are down or not
+        yet hosting the group — the same dance as _raft_config_hook, but
+        returning the op's RESULT. `read` is advisory here: MetaService
+        routes read vs raft ops by op name."""
+        import time
+
+        from chubaofs_tpu.meta.metanode import OpError
+        from chubaofs_tpu.raft.server import NotLeaderError
+
+        del read  # the wire handler dispatches by op name
+        candidates = list(dict.fromkeys(peers))
+        deadline = time.monotonic() + 20
+        last = "no peers reachable"
+        while time.monotonic() < deadline:
+            for peer in list(candidates):
+                node = self.sm.nodes.get(peer)
+                if node is None or not node.addr:
+                    continue
+                try:
+                    return self._meta_handle(peer, node.addr)._call(
+                        pid, op, **args)
+                except NotLeaderError as e:
+                    if isinstance(e.leader, int) and e.leader not in candidates:
+                        candidates.append(e.leader)
+                    last = f"not leader (hint {e.leader})"
+                except OpError as e:
+                    if e.code not in ("ECONN", "EIO", "ENOPARTITION"):
+                        raise  # a real op error (frozen conflict, ...) is
+                        # the ORCHESTRATOR's to handle, not a retry case
+                    last = str(e)
+                except Exception as e:
+                    last = str(e)
+            time.sleep(0.3)
+        raise RuntimeError(f"meta op {op} on mp {pid}: {last}")
+
     def _remove_partition_hook(self, kind: str, pid: int, node_id: int) -> None:
         from chubaofs_tpu.proto.packet import OP_REMOVE_PARTITION, Packet
 
@@ -413,7 +472,14 @@ class MasterDaemon(_Daemon):
                     n = self.sm.nodes.get(peer)
                     if (n and n.addr and now - n.last_heartbeat < 10
                             and mp.partition_id not in n.cursors):
-                        self._meta_hook(mp.partition_id, mp.start, mp.end,
+                        # GENESIS range, not the live view range: the
+                        # respawned node replays its WAL from index 1 into
+                        # this SM, and entries recorded before an in-log
+                        # range shrink (complete_split/set_range_end) only
+                        # replay under the range they were applied under —
+                        # a view-range SM silently drops them (data loss,
+                        # caught by the --meta-split soak)
+                        self._meta_hook(mp.partition_id, mp.start0, mp.end0,
                                         mp.peers, only=peer)
             for dp in vol.data_partitions:
                 for peer in dp.peers:
@@ -529,11 +595,23 @@ class MetaNodeDaemon(_Daemon):
 
         cursors = {pid: sm.cursor
                    for pid, sm in list(self.metanode.partitions.items())}
+        # per-partition op-load window + frozen-split reports ride the beat:
+        # the master's load splitter, meta rebalancer, and split-resume
+        # sweep all read them (ISSUE 15)
+        loads = self.metanode.take_loads()
         try:
             self.mc.heartbeat(self.node_id, partitions=len(cursors),
-                              cursors=cursors, **_space_report(self.data_dir))
+                              cursors=cursors, loads=loads,
+                              splits=self.metanode.split_reports(),
+                              **_space_report(self.data_dir))
         except MasterError:  # "unknown node": master lost state → re-register
+            self.metanode.refund_loads(loads)
             self._register()
+        except Exception:
+            # transport failure: a master hiccup must not erase an observed
+            # load window (the datanode heartbeat's same contract)
+            self.metanode.refund_loads(loads)
+            raise
         _resolve_raft_peers(self.mc, self.net)
 
     def _wire_purge(self, cfg: dict):

@@ -80,6 +80,7 @@ class FsCluster:
             m.datanode_hook = self._create_data_partition
             m.raft_config_hook = self._raft_config
             m.remove_partition_hook = self._remove_partition
+            m.meta_op_hook = self._meta_op
 
         for j in range(1, data_nodes + 1):
             nid = DATANODE_ID_BASE + j
@@ -109,7 +110,11 @@ class FsCluster:
         lead.refresh_dp_hosts()
         for vol in list(lead.sm.volumes.values()):
             for mp in vol.meta_partitions:
-                self._create_meta_partition(mp.partition_id, mp.start, mp.end, mp.peers)
+                # genesis range: WAL replay re-applies any in-log range
+                # shrink (complete_split/set_range_end); a view-range SM
+                # would silently drop pre-shrink entries
+                self._create_meta_partition(mp.partition_id, mp.start0,
+                                            mp.end0, mp.peers)
             for dp in vol.data_partitions:
                 self._create_data_partition(dp.partition_id, dp.peers, dp.hosts)
         self._purge_ec = None
@@ -125,8 +130,30 @@ class FsCluster:
         cond = cond or (lambda: any(m.is_leader for m in self.masters.values()))
         return run_until(self.net, cond, max_ticks=max_ticks)
 
+    def heartbeat_metanodes(self):
+        """One metanode heartbeat round: cursors + op-load window + frozen-
+        split reports into the master (the daemon's 1s loop, pumped
+        explicitly in-process). Refunds the load window on failure so a
+        mid-election master never erases observed load."""
+        for mn in self.metanodes.values():
+            cursors = {pid: sm.cursor
+                       for pid, sm in list(mn.partitions.items())}
+            loads = mn.take_loads()
+            try:
+                self.master().heartbeat(
+                    mn.node_id, partition_count=len(cursors),
+                    cursors=cursors, loads=loads,
+                    splits=mn.split_reports())
+            except Exception:
+                # mid-election this raises NotLeaderError, not just
+                # MasterError — either way keep the window for later
+                # (the daemon heartbeat's same refund-on-any-failure
+                # contract in cmd.py)
+                mn.refund_loads(loads)
+
     def tick_background(self):
         """One pass of the master's background loops + metanode freelists."""
+        self.heartbeat_metanodes()
         lead = self.master()
         lead.check_meta_partitions()
         lead.refresh_leaders(lambda pid: next(
@@ -194,6 +221,37 @@ class FsCluster:
 
         assert self.settle(try_once, max_ticks=1200), \
             f"membership change {action}({node_id}) on {pid} did not commit"
+
+    def _meta_op(self, pid: int, peers: list[int], op: str, args: dict,
+                 read: bool = False):
+        """Run one metanode op on the partition's raft leader (the master's
+        split-orchestration hook): find the leader among the hosting
+        metanodes, pumping raft clocks through elections, and retry
+        leadership races until a bounded deadline."""
+        import time as _time
+
+        from chubaofs_tpu.meta.metanode import OpError
+
+        deadline = _time.monotonic() + 30.0
+        last: Exception | None = None
+        while _time.monotonic() < deadline:
+            for mn in self.metanodes.values():
+                if pid not in mn.partitions or not mn.raft.is_leader(pid):
+                    continue
+                try:
+                    if read:
+                        return getattr(mn, op)(pid, **args)
+                    return mn.submit_sync(pid, op, **args)
+                except (NotLeaderError, OpError) as e:
+                    if isinstance(e, OpError) and e.code not in (
+                            "ECONN", "ENOPARTITION", "EIO"):
+                        raise
+                    last = e
+            # no leader found (fresh group / mid-election): pump the clocks
+            self.settle(lambda: any(
+                pid in mn.partitions and mn.raft.is_leader(pid)
+                for mn in self.metanodes.values()), max_ticks=200)
+        raise MasterError(f"meta op {op} on {pid}: no leader ({last})")
 
     def _remove_partition(self, kind: str, pid: int, node_id: int) -> None:
         from chubaofs_tpu.proto.packet import OP_REMOVE_PARTITION

@@ -86,6 +86,8 @@ class MasterAPI:
         g("/dataNode/decommission", self._w(self.decommission_data, admin=True))
         g("/metaNode/decommission", self._w(self.decommission_meta, admin=True))
         g("/dataNode/rebalanceHot", self._w(self.rebalance_hot, admin=True))
+        g("/metaPartition/rebalance", self._w(self.rebalance_meta, admin=True))
+        g("/metaPartition/split", self._w(self.split_meta, admin=True))
         g("/user/create", self._w(self.user_create, admin=True))
         g("/user/delete", self._w(self.user_delete, admin=True))
         g("/user/info", self._w(self.user_info, leader=False))
@@ -271,6 +273,8 @@ class MasterAPI:
         for mp in d["meta_partitions"]:
             if mp["end"] >= (1 << 62):
                 mp["end"] = -1
+            if mp.get("end0", 0) >= (1 << 62):
+                mp["end0"] = -1
         return d
 
     def get_vol(self, req: Request):
@@ -315,6 +319,7 @@ class MasterAPI:
         raw = req.q("cursors", "")
         cursors = json.loads(raw) if raw else None
         raw_loads = req.q("loads", "")
+        raw_splits = req.q("splits", "")
         total = req.q("total_space", "")
         used = req.q("used_space", "")
         self.master.heartbeat(int(req.q("id")),
@@ -322,7 +327,9 @@ class MasterAPI:
                               cursors=cursors,
                               total_space=int(total) if total else None,
                               used_space=int(used) if used else None,
-                              loads=json.loads(raw_loads) if raw_loads else None)
+                              loads=json.loads(raw_loads) if raw_loads else None,
+                              splits=json.loads(raw_splits) if raw_splits
+                              else None)
         return None
 
     def decommission_meta(self, req: Request):
@@ -340,6 +347,31 @@ class MasterAPI:
         return {"moved": moved,
                 "loads": {str(k): v
                           for k, v in self.master.data_node_loads().items()}}
+
+    def rebalance_meta(self, req: Request):
+        """One meta-partition migration sweep (hot metanodes shed their
+        hottest partition replicas onto cold metanodes — ISSUE 15); returns
+        the moves made plus the per-metanode load view it acted on."""
+        moved = self.master.rebalance_meta(
+            factor=float(req.q("factor", "1.5")),
+            max_moves=int(req.q("maxMoves", "1")))
+        return {"moved": moved,
+                "loads": {str(k): v
+                          for k, v in self.master.meta_node_loads().items()}}
+
+    def split_meta(self, req: Request):
+        """Load-split one named meta partition at its median live inode now
+        (the bench/operator trigger; the CFS_META_SPLIT_OPS path drives the
+        same machinery from heartbeat loads). Returns the sibling pid, 0
+        when the partition declines (too few inodes / txns in flight)."""
+        name = req.q("name")
+        if not name:
+            raise MasterError("missing ?name")
+        try:
+            pid = int(req.q("id"))
+        except (TypeError, ValueError):
+            raise MasterError("missing/bad ?id") from None
+        return {"new_pid": self.master.split_meta_partition(name, pid)}
 
     @staticmethod
     def _user_view(u) -> dict:
@@ -548,14 +580,24 @@ class MasterClient:
                   cursors: dict | None = None,
                   total_space: int | None = None,
                   used_space: int | None = None,
-                  loads: dict | None = None):
+                  loads: dict | None = None,
+                  splits: dict | None = None):
         import json
 
         return self.call(self._path(
             "/node/heartbeat", id=node_id, partitions=partitions,
             cursors=None if cursors is None else json.dumps(cursors),
             total_space=total_space, used_space=used_space,
-            loads=None if loads is None else json.dumps(loads)))
+            loads=None if loads is None else json.dumps(loads),
+            splits=None if splits is None else json.dumps(splits)))
+
+    def rebalance_meta(self, factor: float = 1.5, max_moves: int = 1):
+        return self.call(self._path("/metaPartition/rebalance", factor=factor,
+                                    maxMoves=max_moves))
+
+    def split_meta_partition(self, name: str, pid: int):
+        return self.call(self._path("/metaPartition/split", name=name,
+                                    id=pid))
 
     def rebalance_hot(self, factor: float = 1.5, max_moves: int = 2):
         return self.call(self._path("/dataNode/rebalanceHot", factor=factor,

@@ -46,9 +46,14 @@ class NodeInfo:
     nodeset: int = 0  # zone-local nodeset index (bounded failure groups)
     total_space: int = 0  # bytes, node-reported via heartbeat (statinfo)
     used_space: int = 0
-    # pid -> ops served in the node's last heartbeat window (datanode
-    # take_loads() delta) — the hot-volume rebalancer's accounting feed
+    # pid -> ops served in the node's last heartbeat window (datanode/
+    # metanode take_loads() delta) — the hot-volume rebalancer's and the
+    # meta splitter's accounting feed
     loads: dict[int, float] = field(default_factory=dict)
+    # pid -> replicated split_info for meta partitions FROZEN mid-split on
+    # this node (metanode split_reports()) — the resume sweep's feed: a
+    # split whose orchestrator died finishes from the partition's own state
+    splits: dict[int, dict] = field(default_factory=dict)
 
     @property
     def schedulable(self) -> bool:
@@ -62,6 +67,27 @@ class MetaPartitionView:
     end: int  # exclusive; INF for the tail partition
     peers: list[int] = field(default_factory=list)
     leader: int | None = None
+    # GENESIS range — the range this partition's raft group was CREATED
+    # with, before any split shrank the live view. Every re-create of the
+    # partition on a node (respawn re-host, migration replica, replica-count
+    # heal) MUST use this range, not start/end: a recovering SM replays its
+    # WAL from index 1, and ops recorded before an in-log range change
+    # (freeze_range/complete_split/set_range_end) were applied under the
+    # genesis range — an SM born with the post-split view range would
+    # silently refuse them (OutOfRange/WrongPartition no-ops during replay)
+    # and lose committed entries. 0 = derive from start/end at construction.
+    start0: int = 0
+    end0: int = 0
+
+    def __post_init__(self):
+        # creation sites construct views with start/end = the creation
+        # range, so capturing it here IS the genesis; restore passes the
+        # persisted values explicitly (old snapshots: re-derived — those
+        # partitions predate mid-range splits, where view == genesis)
+        if not self.start0:
+            self.start0 = self.start
+        if not self.end0:
+            self.end0 = self.end
 
 
 @dataclass
@@ -161,6 +187,8 @@ class MasterSM(StateMachine):
                 # .get: snapshots from before load accounting existed
                 d["loads"] = {int(k): float(v)
                               for k, v in d.get("loads", {}).items()}
+                d["splits"] = {int(k): dict(v)
+                               for k, v in d.get("splits", {}).items()}
                 n = NodeInfo(**d)
                 self.nodes[n.node_id] = n
 
@@ -258,7 +286,8 @@ class MasterSM(StateMachine):
                       cursors: dict | None = None, now: float = 0.0,
                       total_space: int | None = None,
                       used_space: int | None = None,
-                      loads: dict | None = None):
+                      loads: dict | None = None,
+                      splits: dict | None = None):
         n = self.nodes.get(node_id)
         if n is None:
             raise MasterError(f"unknown node {node_id}")
@@ -280,6 +309,9 @@ class MasterSM(StateMachine):
         # per-partition op-load window (same replace-vs-no-report contract)
         if loads is not None:
             n.loads = {int(k): float(v) for k, v in loads.items()}
+        # frozen mid-split partitions this node hosts (resume sweep feed)
+        if splits is not None:
+            n.splits = {int(k): dict(v) for k, v in splits.items()}
         return None
 
     def _op_create_volume(self, name: str, owner: str, capacity: int, cold: bool,
@@ -350,6 +382,39 @@ class MasterSM(StateMachine):
             MetaPartitionView(new_partition_id, start=split_at, end=INF, peers=peers)
         )
         return vol.meta_partitions[-1]
+
+    def _op_split_partition_mid(self, vol_name: str, partition_id: int,
+                                split_at: int, new_partition_id: int,
+                                peers: list[int]):
+        """THE atomic view swap of a mid-range load split (ISSUE 15): in one
+        master-raft commit the old partition's range shrinks to
+        [start, split_at) and the sibling enters the view owning
+        [split_at, old_end) — no inode is ever owned by zero or two
+        partitions in the authoritative view. Idempotent: a resumed
+        orchestrator re-proposing an already-swapped split no-ops."""
+        vol = self.volumes.get(vol_name)
+        if vol is None:
+            raise MasterError(f"unknown volume {vol_name!r}")
+        for mp in vol.meta_partitions:
+            if mp.partition_id == new_partition_id:
+                return mp  # already swapped (resume replay)
+        for i, mp in enumerate(vol.meta_partitions):
+            if mp.partition_id != partition_id:
+                continue
+            if not (mp.start < split_at < mp.end):
+                raise MasterError(
+                    f"split_at {split_at} outside ({mp.start}, {mp.end})")
+            new_mp = MetaPartitionView(new_partition_id, start=split_at,
+                                       end=mp.end, peers=list(peers))
+            mp.end = split_at
+            # keep meta_partitions sorted by start: routing (and the tail
+            # convention meta_partitions[-1]) depend on range order
+            vol.meta_partitions.insert(i + 1, new_mp)
+            for p in peers:
+                if p in self.nodes:
+                    self.nodes[p].partition_count += 1
+            return new_mp
+        raise MasterError(f"unknown partition {partition_id}")
 
     def _op_set_partition_leader(self, vol_name: str, partition_id: int, leader: int | None):
         vol = self.volumes.get(vol_name)
@@ -505,6 +570,22 @@ class Master:
         # the retired replica
         self.raft_config_hook = None
         self.remove_partition_hook = None
+        # metadata-op plumbing for the mid-range split orchestrator
+        # (deployment-wired): meta_op_hook(pid, peers, op, args, read=False)
+        # runs one metanode op on the partition's leader with retry/hint
+        # handling and returns its result
+        self.meta_op_hook = None
+        # load-split trigger: a meta partition whose heartbeat-window op
+        # count reaches this splits at its median live inode. 0 = off (the
+        # operator or the capacity harness triggers explicit splits instead).
+        # CFS_META_SPLIT_OPS env / metaSplitOps daemon config.
+        import os as _os
+
+        try:
+            self.meta_split_ops = float(
+                _os.environ.get("CFS_META_SPLIT_OPS", "0") or 0)
+        except ValueError:
+            self.meta_split_ops = 0.0
         # nodes already fully drained by the dead-node sweep; in-memory only
         # (rebuilt by one sweep after a restart), cleared on returning heartbeat.
         # Own micro-lock: heartbeat clears this set on its hot path and must
@@ -561,7 +642,8 @@ class Master:
                   cursors: dict | None = None,
                   total_space: int | None = None,
                   used_space: int | None = None,
-                  loads: dict | None = None):
+                  loads: dict | None = None,
+                  splits: dict | None = None):
         # a returning node may receive new placements again, so the dead-node
         # sweep must re-examine it if it dies a second time
         with self._drained_lock:
@@ -569,7 +651,7 @@ class Master:
         self._apply("heartbeat", node_id=node_id, partition_count=partition_count,
                     cursors=cursors, now=time.time(),
                     total_space=total_space, used_space=used_space,
-                    loads=loads)
+                    loads=loads, splits=splits)
 
     def cluster_stat(self) -> dict:
         """Cluster/zone space + health rollup from node heartbeat reports.
@@ -849,33 +931,63 @@ class Master:
                             "moved": moved})
         return moved
 
+    def _move_mp_replica(self, vol, mp, node_id: int,
+                         prefer_zone: str | None = None,
+                         repl: int | None = None,
+                         reason: str = "decommission") -> None:
+        """Move one meta-partition replica off node_id (decommission,
+        dead-node re-home and hot-partition rebalance all share this step):
+        create the group on the replacement (it catches up via raft
+        snapshot/appends) -> propose add(replacement) -> propose
+        remove(victim) -> drop state on the victim -> record the new
+        membership. An explicit `repl` (the rebalancer's load-ranked pick)
+        skips the zone/domain-ranked _pick_addition. Emits `meta_migrate`
+        at the add-peer and remove-peer transitions so cfs-events can
+        reconstruct the move."""
+        from chubaofs_tpu.utils import events
+
+        survivors = [p for p in mp.peers if p != node_id]
+        if repl is None:
+            repl = self._pick_addition(
+                "meta", survivors, exclude={node_id},
+                prefer_zone=prefer_zone).node_id
+        new_peers = survivors + [repl]
+        if self.metanode_hook:
+            # replacement-only create with the final membership — at the
+            # GENESIS range: the new replica may catch up via appends from
+            # index 1, and replaying under the post-split view range would
+            # drop committed entries (the in-log range ops re-shrink it)
+            self.metanode_hook(mp.partition_id, mp.start0, mp.end0,
+                               new_peers, only=repl)
+        events.emit("meta_migrate", entity=f"mp{mp.partition_id}",
+                    detail={"partition": mp.partition_id, "vol": vol.name,
+                            "victim": node_id, "replacement": repl,
+                            "phase": "add_peer", "reason": reason})
+        if self.raft_config_hook:
+            self.raft_config_hook("meta", mp.partition_id, "add",
+                                  repl, mp.peers)
+            # contact set for the remove must still include the victim:
+            # it is often the group's raft leader and must propose its
+            # own removal (then step down on apply)
+            self.raft_config_hook("meta", mp.partition_id, "remove",
+                                  node_id, mp.peers + [repl])
+        if self.remove_partition_hook:
+            self.remove_partition_hook("meta", mp.partition_id, node_id)
+        self._apply("update_mp_peers", vol_name=vol.name,
+                    partition_id=mp.partition_id, peers=new_peers)
+        events.emit("meta_migrate", entity=f"mp{mp.partition_id}",
+                    detail={"partition": mp.partition_id, "vol": vol.name,
+                            "victim": node_id, "replacement": repl,
+                            "phase": "remove_peer", "reason": reason})
+
     def _migrate_metanode(self, node_id: int) -> int:
         moved = 0
+        zone = self.sm.nodes[node_id].zone
         for vol in list(self.sm.volumes.values()):
             for mp in vol.meta_partitions:
                 if node_id not in mp.peers:
                     continue
-                survivors = [p for p in mp.peers if p != node_id]
-                repl = self._pick_addition(
-                    "meta", survivors, exclude={node_id},
-                    prefer_zone=self.sm.nodes[node_id].zone).node_id
-                new_peers = survivors + [repl]
-                if self.metanode_hook:
-                    # replacement-only create with the final membership
-                    self.metanode_hook(mp.partition_id, mp.start, mp.end,
-                                       new_peers, only=repl)
-                if self.raft_config_hook:
-                    self.raft_config_hook("meta", mp.partition_id, "add",
-                                          repl, mp.peers)
-                    # contact set for the remove must still include the victim:
-                    # it is often the group's raft leader and must propose its
-                    # own removal (then step down on apply)
-                    self.raft_config_hook("meta", mp.partition_id, "remove",
-                                          node_id, mp.peers + [repl])
-                if self.remove_partition_hook:
-                    self.remove_partition_hook("meta", mp.partition_id, node_id)
-                self._apply("update_mp_peers", vol_name=vol.name,
-                            partition_id=mp.partition_id, peers=new_peers)
+                self._move_mp_replica(vol, mp, node_id, prefer_zone=zone)
                 moved += 1
         return moved
 
@@ -1074,13 +1186,340 @@ class Master:
                         break
             return moved
 
+    # -- metadata scale-out: load split + cross-metanode rebalance (ISSUE 15) --
+
+    def meta_node_loads(self) -> dict[int, float]:
+        """node_id -> total meta ops in the last heartbeat window,
+        schedulable metanodes only (the rebalance/split accounting view)."""
+        return {n.node_id: sum(n.loads.values())
+                for n in self.sm.nodes.values()
+                if n.kind == "meta" and n.schedulable}
+
+    def _find_meta_mp(self, pid: int):
+        for vol in self.sm.volumes.values():
+            for mp in vol.meta_partitions:
+                if mp.partition_id == pid:
+                    return vol, mp
+        return None, None
+
+    def meta_partition_loads(self) -> dict[int, float]:
+        """pid -> hottest replica's heartbeat-window op count (the leader
+        serves every client op, so max-across-replicas IS the serving load).
+        Inactive nodes are excluded: loads only refresh on a heartbeat, so
+        a dead node's window is frozen at its last report — a ghost that
+        would re-split the same partition every sweep."""
+        out: dict[int, float] = {}
+        for n in self.sm.nodes.values():
+            if n.kind != "meta" or n.status != "active":
+                continue
+            for pid, load in n.loads.items():
+                out[pid] = max(out.get(pid, 0.0), float(load))
+        return out
+
+    def rebalance_meta(self, factor: float = 1.5, max_moves: int = 1) -> int:
+        """Cross-metanode migration of hot meta partitions: any schedulable
+        metanode whose heartbeat-window op load exceeds `factor` x the mean
+        sheds its hottest partition replicas onto the coldest metanodes not
+        already in the peer set, through the same create -> raft-add ->
+        raft-remove -> drop dance decommission uses (_move_mp_replica).
+        The data plane got this in PR 11 (rebalance_hot); this is the meta
+        plane's analog. Strict-improvement gated so the sweep converges,
+        bounded at `max_moves` (mp moves ship a namespace snapshot — heavier
+        than a dp replica, so the default is conservative)."""
+        if not self.is_leader:
+            return 0
+        with self._decomm_lock:
+            # active only: a dead node's load window is frozen at its last
+            # heartbeat (a ghost shedder), and worse, its idle-looking
+            # window makes it the coldest MOVE TARGET
+            metas = {n.node_id: n for n in self.sm.nodes.values()
+                     if n.kind == "meta" and n.schedulable
+                     and n.status == "active"}
+            if len(metas) < 2:
+                return 0
+            loads = {nid: sum(n.loads.values()) for nid, n in metas.items()}
+            total = sum(loads.values())
+            if total <= 0:
+                return 0
+            mean = total / len(loads)
+            moved = 0
+            for nid in sorted(loads, key=loads.get, reverse=True):
+                if moved >= max_moves:
+                    break
+                # snapshot ONCE (rebalance_hot rationale): the raft applier
+                # REPLACES n.loads on every heartbeat mid-sweep
+                pid_loads = dict(metas[nid].loads)
+                for pid in sorted(pid_loads, key=pid_loads.get, reverse=True):
+                    if loads[nid] <= factor * mean:
+                        break  # shed enough; next hot node
+                    pid_load = pid_loads.get(pid, 0.0)
+                    if pid_load <= 0:
+                        break
+                    vol, mp = self._find_meta_mp(pid)
+                    if mp is None or nid not in mp.peers:
+                        continue  # data pid, or a replica already moved
+                    cands = [n for n in metas.values()
+                             if n.node_id not in mp.peers]
+                    if not cands:
+                        continue
+                    target = min(cands, key=lambda n: (loads[n.node_id],
+                                                       n.partition_count))
+                    if loads[target.node_id] + pid_load >= loads[nid]:
+                        continue  # would not strictly improve the pair
+                    try:
+                        self._move_mp_replica(vol, mp, nid,
+                                              repl=target.node_id,
+                                              reason="rebalance_meta")
+                    except MasterError:
+                        continue  # no capacity after all; retried next sweep
+                    loads[nid] -= pid_load
+                    loads[target.node_id] += pid_load
+                    moved += 1
+                    if moved >= max_moves:
+                        break
+            return moved
+
+    def split_meta_partition(self, vol_name: str, partition_id: int) -> int:
+        """Operator/bench entry: load-split ONE named partition at its
+        median live inode, now. Returns the sibling's pid (0 = partition
+        declined: too few live inodes, or a 2PC txn in flight)."""
+        vol = self.get_volume(vol_name)
+        mp = next((m for m in vol.meta_partitions
+                   if m.partition_id == partition_id), None)
+        if mp is None:
+            raise MasterError(f"unknown meta partition {partition_id}")
+        with self._decomm_lock:
+            try:
+                return self._split_meta_partition(vol, mp)
+            except Exception as e:
+                if getattr(e, "code", None) == "ETXCONFLICT":
+                    # the documented decline (prepared 2PC txns in flight,
+                    # bounded by TX_TTL), not an error: retry shortly
+                    return 0
+                raise
+
+    def resume_meta_splits(self) -> int:
+        """Finish splits whose orchestrator died mid-flight: metanode
+        heartbeats report frozen partitions (NodeInfo.splits), and every
+        step of _split_meta_partition is idempotent, so re-driving from the
+        replicated split_info converges. A frozen partition that already
+        left the view is unfrozen (volume deleted mid-split)."""
+        if not self.is_leader:
+            return 0
+        finished = 0
+        seen: set[int] = set()
+        for n in list(self.sm.nodes.values()):
+            if n.kind != "meta":
+                continue
+            for pid, info in dict(n.splits).items():
+                if pid in seen:
+                    continue
+                seen.add(pid)
+                vol, mp = self._find_meta_mp(pid)
+                if mp is None:
+                    if self.meta_op_hook:
+                        try:
+                            self.meta_op_hook(pid, [n.node_id],
+                                              "unfreeze_range", {})
+                        except Exception:
+                            pass  # node may be rebooting; retried next sweep
+                    continue
+                with self._decomm_lock:
+                    try:
+                        if self._split_meta_partition(vol, mp, resume=info):
+                            finished += 1
+                    except Exception:
+                        # a mid-resume replica crash surfaces as a hook
+                        # timeout/OpError: the partition stays frozen and
+                        # the next sweep re-resumes — never kill the sweep
+                        pass
+        return finished
+
+    def _split_meta_partition(self, vol, mp, resume: dict | None = None) -> int:
+        """Drive one mid-range split end to end (caller holds _decomm_lock):
+        freeze the upper half at the median -> snapshot-copy it into a
+        sibling raft group -> atomically swap the volume view in one master
+        commit -> drop the moved entries. Any failure leaves the partition
+        FROZEN with a replicated resume record; resume_meta_splits finishes
+        it. Returns the sibling pid, 0 when the partition declines."""
+        from chubaofs_tpu.utils import events
+
+        if self.meta_op_hook is None or self.metanode_hook is None:
+            return 0
+        old_end = mp.end
+        if resume is None:
+            split_at = self.meta_op_hook(mp.partition_id, mp.peers,
+                                         "split_point", {}, read=True)
+            if not split_at:
+                return 0
+            new_pid = self._apply("alloc_id")
+            new_peers = self._pick_meta_peers()
+            # the fence + the replicated resume record, in one raft commit
+            # on the partition itself
+            self.meta_op_hook(mp.partition_id, mp.peers, "freeze_range",
+                              {"split_at": split_at, "new_pid": new_pid,
+                               "new_peers": new_peers})
+            events.emit("meta_split", entity=f"mp{mp.partition_id}",
+                        detail={"partition": mp.partition_id, "vol": vol.name,
+                                "split_at": split_at, "new_pid": new_pid,
+                                "phase": "freeze"})
+        else:
+            split_at = int(resume["split_at"])
+            new_pid = int(resume["new_pid"])
+            new_peers = [int(p) for p in resume.get("new_peers", [])] \
+                or self._pick_meta_peers()
+            if any(m.partition_id == new_pid for m in vol.meta_partitions):
+                # view already swapped: only the cleanup tail is missing
+                self.meta_op_hook(mp.partition_id, mp.peers,
+                                  "complete_split", {})
+                events.emit("meta_split", entity=f"mp{mp.partition_id}",
+                            detail={"partition": mp.partition_id,
+                                    "vol": vol.name, "new_pid": new_pid,
+                                    "phase": "complete", "resumed": True})
+                # a resumed TAIL split still owes the chain: without it the
+                # sibling keeps the open range and the volume settles at 2
+                # partitions with the hotspot re-forming on the sibling
+                self._chain_tail_split(vol, new_pid)
+                return new_pid
+        # sibling raft group on the chosen peers (idempotent: create skips
+        # peers already hosting the pid), range [split_at, old_end)
+        self.metanode_hook(new_pid, split_at, old_end, new_peers)
+        # snapshot-copy the frozen sub-range, page by page (the freeze makes
+        # paging consistent; import is a keyed upsert, so replays are safe)
+        after = 0
+        src_cursor = 0
+        while True:
+            page = self.meta_op_hook(mp.partition_id, mp.peers,
+                                     "export_range", {"after": after},
+                                     read=True)
+            src_cursor = page.get("cursor") or src_cursor
+            # the final page always ships (even empty): it carries the
+            # final=True that triggers the sibling's one quota recount
+            if page["inodes"] or page["dentries"] or not after or page["done"]:
+                self.meta_op_hook(new_pid, new_peers, "import_entries",
+                                  {"inodes": page["inodes"],
+                                   "dentries": page["dentries"],
+                                   "cursor": page.get("cursor"),
+                                   "quotas": page.get("quotas"),
+                                   "final": bool(page["done"])})
+            if page["done"]:
+                break
+            after = page["next"]
+        # THE atomic swap: one master-raft commit moves ownership of
+        # [split_at, old_end) to the sibling — never zero or two owners
+        self._apply("split_partition_mid", vol_name=vol.name,
+                    partition_id=mp.partition_id, split_at=split_at,
+                    new_partition_id=new_pid, peers=new_peers)
+        events.emit("meta_split", entity=f"mp{mp.partition_id}",
+                    detail={"partition": mp.partition_id, "vol": vol.name,
+                            "split_at": split_at, "new_pid": new_pid,
+                            "peers": list(new_peers), "phase": "commit"})
+        # cleanup tail: drop the moved entries + shrink end + lift the fence
+        self.meta_op_hook(mp.partition_id, mp.peers, "complete_split", {})
+        events.emit("meta_split", entity=f"mp{mp.partition_id}",
+                    detail={"partition": mp.partition_id, "vol": vol.name,
+                            "new_pid": new_pid, "phase": "complete"})
+        if old_end >= INF:
+            self._chain_tail_split(vol, new_pid, src_cursor)
+        return new_pid
+
+    def _chain_tail_split(self, vol, new_pid: int,
+                          src_cursor: int = 0) -> None:
+        """A load split of the TAIL chains a cursor split of the sibling:
+        the sibling inherited the open range, so every NEW create would
+        land on it — the hot partition the split just relieved would
+        re-form immediately. Capping it at cursor+headroom opens a fresh
+        tail on (usually) other metanodes, and the capped sibling keeps
+        serving its directories' combined creates from the headroom.
+        Best-effort: failing the chain just leaves the sibling as the open
+        tail (pre-chain behavior). The resume path has no export cursor, so
+        it falls back to the sibling's heartbeat-reported cursor (resume is
+        itself heartbeat-driven, so one is normally already on file)."""
+        from chubaofs_tpu.utils import events
+
+        sib = next((m for m in vol.meta_partitions
+                    if m.partition_id == new_pid), None)
+        if sib is None or sib.end < INF:
+            return
+        cursor = src_cursor or max(
+            (n.cursors.get(new_pid, 0) for n in self.sm.nodes.values()),
+            default=0)
+        if not cursor:
+            return
+        try:
+            self._cursor_split(vol, sib, cursor + SPLIT_HEADROOM)
+            events.emit("meta_split", entity=f"mp{new_pid}",
+                        detail={"partition": new_pid, "vol": vol.name,
+                                "phase": "chain",
+                                "split_at": cursor + SPLIT_HEADROOM})
+        except Exception:
+            pass
+
+    def _cursor_split(self, vol, tail, split_at: int) -> int:
+        """One cursor split of the tail: cap the old tail at split_at (its
+        headroom keeps serving combined creates for directories it owns) and
+        open a fresh tail. The SM's range end shrinks FIRST (set_range_end,
+        a replicated op): without it the old SM keeps end=INF and its
+        combined-create path would allocate inodes beyond the view range —
+        unroutable files. Ordered so a failure between the two commits
+        leaves behavior safe: a capped SM without the view swap just answers
+        ERANGE at the cap until the next sweep retries the split. The SM
+        answers with the cap it actually holds (an earlier failed attempt
+        may have committed a LOWER one while the cursor kept advancing);
+        the view swap must use that cap or the retry never converges."""
+        if self.meta_op_hook is not None:
+            got = self.meta_op_hook(tail.partition_id, tail.peers,
+                                    "set_range_end", {"end": split_at})
+            if got:
+                split_at = int(got)
+        new_pid = self._apply("alloc_id")
+        peers = self._pick_meta_peers()
+        self._apply(
+            "split_partition", vol_name=vol.name,
+            partition_id=tail.partition_id,
+            split_at=split_at, new_partition_id=new_pid, peers=peers,
+        )
+        if self.metanode_hook:
+            self.metanode_hook(new_pid, split_at, INF, peers)
+        return 1
+
+    def split_hot_meta_partitions(self, threshold: float,
+                                  max_splits: int = 1) -> int:
+        """The load path: split the hottest meta partition whose heartbeat-
+        window op count reached `threshold` (a directory-heavy tenant pins
+        one raft group without this — the skewed regimes of arxiv
+        1709.05365). Bounded per sweep: a split ships half a namespace."""
+        if not self.is_leader or threshold <= 0:
+            return 0
+        done = 0
+        loads = self.meta_partition_loads()
+        for pid in sorted(loads, key=loads.get, reverse=True):
+            if done >= max_splits or loads[pid] < threshold:
+                break
+            vol, mp = self._find_meta_mp(pid)
+            if mp is None:
+                continue
+            with self._decomm_lock:
+                try:
+                    if self._split_meta_partition(vol, mp):
+                        done += 1
+                except Exception:
+                    continue  # frozen state + heartbeat reports resume it
+        return done
+
     # -- background checks (scheduleTask loop analogs) --------------------------
 
     def check_meta_partitions(self) -> int:
-        """Split tail partitions whose cursor nears the end (cursor growth)."""
+        """Meta-partition growth sweep: (1) split tail partitions whose
+        cursor nears the range end (cursor growth), (2) resume mid-range
+        splits stranded by a crashed orchestrator, (3) load-split HOT
+        mid-range partitions when CFS_META_SPLIT_OPS arms a threshold."""
         if not self.is_leader:
             return 0
-        splits = 0
+        # resume FIRST: a stranded load split can leave the tail frozen, and
+        # a frozen tail refuses set_range_end — cursor growth on it can only
+        # succeed after the resume lifts the fence
+        splits = self.resume_meta_splits()
         for vol in list(self.sm.volumes.values()):
             tail = vol.meta_partitions[-1]
             cursor = max(
@@ -1089,16 +1528,15 @@ class Master:
             )
             bound = tail.start + META_RANGE_STEP
             if cursor and cursor >= bound - SPLIT_HEADROOM:
-                new_pid = self._apply("alloc_id")
-                peers = self._pick_meta_peers()
                 split_at = cursor + SPLIT_HEADROOM
-                self._apply(
-                    "split_partition", vol_name=vol.name, partition_id=tail.partition_id,
-                    split_at=split_at, new_partition_id=new_pid, peers=peers,
-                )
-                if self.metanode_hook:
-                    self.metanode_hook(new_pid, split_at, INF, peers)
-                splits += 1
+                try:
+                    splits += self._cursor_split(vol, tail, split_at)
+                except Exception:
+                    # one volume's refusal (e.g. ESPLIT on a tail whose
+                    # resume is still owed) must not abort the sweep for
+                    # the other volumes or the hot-split pass below
+                    continue
+        splits += self.split_hot_meta_partitions(self.meta_split_ops)
         return splits
 
     def check_node_liveness(self, timeout: float = 10.0,
@@ -1229,8 +1667,10 @@ class Master:
                         break  # not enough healthy nodes; retried next sweep
                     new_peers = mp.peers + [repl]
                     if self.metanode_hook:
-                        self.metanode_hook(mp.partition_id, mp.start, mp.end,
-                                           new_peers, only=repl)
+                        # genesis range (see _move_mp_replica): the healed
+                        # replica replays/catches up from the log start
+                        self.metanode_hook(mp.partition_id, mp.start0,
+                                           mp.end0, new_peers, only=repl)
                     if self.raft_config_hook:
                         self.raft_config_hook("meta", mp.partition_id, "add",
                                               repl, mp.peers)

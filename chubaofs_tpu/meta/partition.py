@@ -58,6 +58,15 @@ class CrossPartition(MetaError):
     code = "EXDEVPART"
 
 
+class WrongPartition(MetaError):
+    """The routed inode is outside this partition's live range — the
+    client's volume view is stale (a mid-range split moved the sub-range)
+    or the sub-range is frozen mid-split. Pure pre-check (nothing mutated):
+    the client refreshes its view and re-routes instead of failing the op."""
+
+    code = "EWRONGPART"
+
+
 class TxConflict(MetaError):
     code = "ETXCONFLICT"
 
@@ -68,6 +77,15 @@ class QuotaExceeded(MetaError):
 
 class OutOfRange(MetaError):
     code = "ERANGE"
+
+
+class SplitRefused(MetaError):
+    """A split-orchestration op refused by the SM's own state (freeze
+    conflict, frozen range, shrink violation, unfrozen export): the
+    ORCHESTRATOR must handle it — retrying the same op can never succeed,
+    so the meta-op hooks must not classify it as a transport failure."""
+
+    code = "ESPLIT"
 
 
 @dataclass
@@ -148,6 +166,15 @@ class MetaPartitionSM(StateMachine):
         # directory quotas (metanode quota + master_quota_manager):
         # qid -> {max_files, max_bytes, files, bytes, exceeded}
         self.quotas: dict[int, dict] = {}
+        # mid-range load split (ISSUE 15): while a split is in flight the
+        # sub-range [frozen_from, end) is FROZEN — reads and writes there
+        # raise WrongPartition until the master swaps the volume view and
+        # complete_split drops the moved entries. split_info carries the
+        # replicated resume record {split_at, new_pid, new_peers} so a
+        # crashed orchestrator (or a restarted replica) can finish the
+        # split from the partition's own state.
+        self.frozen_from: int | None = None
+        self.split_info: dict | None = None
         self._apply_now = 0.0  # proposer-stamped wall clock of the last op
         if start == ROOT_INO:
             root = Inode(ino=ROOT_INO, mode=stat_mod.S_IFDIR | 0o755, nlink=2)
@@ -227,6 +254,7 @@ class MetaPartitionSM(StateMachine):
         w.add("meta", {
             "partition_id": self.partition_id, "start": self.start,
             "end": self.end, "cursor": self.cursor, "del_seq": self.del_seq,
+            "frozen_from": self.frozen_from, "split_info": self.split_info,
         })
         w.add_batched("inodes", (self._inode_wire(i) for i in self.inodes.values()))
         w.add_batched("dentries", ([d.parent, d.name, d.ino, d.mode]
@@ -251,6 +279,9 @@ class MetaPartitionSM(StateMachine):
             self.partition_id = m["partition_id"]
             self.start, self.end = m["start"], m["end"]
             self.cursor, self.del_seq = m["cursor"], m["del_seq"]
+            # .get: snapshots from before mid-range splits existed
+            self.frozen_from = m.get("frozen_from")
+            self.split_info = m.get("split_info")
 
         def load_inodes(batch):
             for rec in batch:
@@ -284,10 +315,37 @@ class MetaPartitionSM(StateMachine):
             "quotas": lambda v: setattr(self, "quotas", dict(v)),
         })
 
+    # -- routing ownership (mid-range split fencing) ---------------------------
+
+    def owns_ino_live(self, ino: int) -> bool:
+        """owns_ino minus the frozen sub-range: while a split is in flight
+        the entries >= frozen_from are leaving this partition, and serving
+        them here (reads included — the sibling starts serving writes the
+        instant the view swaps, BEFORE complete_split shrinks `end`) would
+        hand out stale state."""
+        if self.frozen_from is not None and ino >= self.frozen_from:
+            return False
+        return self.start <= ino < self.end
+
+    def _route_guard(self, ino: int) -> None:
+        if not self.owns_ino_live(ino):
+            live_end = self.frozen_from if self.frozen_from is not None \
+                else self.end
+            raise WrongPartition(
+                f"ino {ino} not served by partition {self.partition_id} "
+                f"[{self.start}, {live_end})")
+
     # -- fsm ops: inodes -------------------------------------------------------
 
     def _next_ino(self) -> int:
-        if self.cursor + 1 >= self.end:
+        bound = self.frozen_from if self.frozen_from is not None else self.end
+        if self.cursor + 1 >= bound:
+            if self.frozen_from is not None:
+                # the free tail of the range is moving to the sibling (it
+                # inherits the cursor): re-route, don't report exhaustion
+                raise WrongPartition(
+                    f"partition {self.partition_id} allocations moved by "
+                    f"split at {self.frozen_from}")
             raise OutOfRange(f"partition {self.partition_id} inode range exhausted")
         self.cursor += 1
         return self.cursor
@@ -318,12 +376,16 @@ class MetaPartitionSM(StateMachine):
         rmdir/unlink type expectation inside the commit (no TOCTOU against
         a concurrent rename-over). Raises CrossPartition when the child
         inode lives elsewhere; the client falls back to the per-op flow."""
+        self._route_guard(parent)
         d = self.dentries.get((parent, name))
         if d is None:
             raise NoEntry(f"{name!r} in {parent}")
         if want_dir is not None and stat_mod.S_ISDIR(d.mode) != want_dir:
             raise (NotDir if want_dir else IsDir)(f"{name!r}")
-        if not self.owns_ino(d.ino):
+        if not self.owns_ino_live(d.ino):
+            # owns_ino_live: a child in the FROZEN sub-range must not be
+            # mutated here either — the client falls back to the per-op
+            # flow, whose unlink re-routes once the view swaps
             raise CrossPartition(f"ino {d.ino} outside [{self.start},{self.end})")
         self._op_delete_dentry(parent, name, quota_ids=quota_ids)
         inode = self._op_unlink_inode(d.ino)
@@ -340,6 +402,7 @@ class MetaPartitionSM(StateMachine):
         runs BEFORE the inode allocates, so a failed create leaves nothing
         behind to undo and burns no inode-range slot."""
         key = (parent, name)
+        self._route_guard(parent)
         self._check_lock(("d", parent, name), None)
         self._check_lock(("c", parent), None)
         if key in self.dentries:
@@ -370,6 +433,7 @@ class MetaPartitionSM(StateMachine):
         return _json.loads(raw)
 
     def _op_unlink_inode(self, ino: int):
+        self._route_guard(ino)
         inode = self._get_inode(ino)
         inode.nlink -= 1
         if inode.is_dir:
@@ -379,6 +443,7 @@ class MetaPartitionSM(StateMachine):
         return inode
 
     def _op_evict_inode(self, ino: int):
+        self._route_guard(ino)
         inode = self.inodes.get(ino)
         if inode is None:
             return None
@@ -395,6 +460,7 @@ class MetaPartitionSM(StateMachine):
     def _op_update_inode(self, ino: int, size: int | None = None, mode: int | None = None,
                          uid: int | None = None, gid: int | None = None,
                          mtime: float | None = None):
+        self._route_guard(ino)
         inode = self._get_inode(ino)
         if size is not None:
             inode.size = size
@@ -409,6 +475,7 @@ class MetaPartitionSM(StateMachine):
 
     def _op_append_extents(self, ino: int, extents: list[dict], size: int):
         """AppendExtentKey analog (sdk/meta/api.go:1137): extend the file map."""
+        self._route_guard(ino)
         inode = self._get_inode(ino)
         grow = max(0, size - inode.size)
         if grow:
@@ -421,6 +488,7 @@ class MetaPartitionSM(StateMachine):
 
     def _op_append_obj_extents(self, ino: int, locations: list[dict], size: int):
         """Cold tier: record blobstore locations (ObjExtents, inode.go:73-74)."""
+        self._route_guard(ino)
         inode = self._get_inode(ino)
         grow = max(0, size - inode.size)
         if grow:
@@ -431,6 +499,7 @@ class MetaPartitionSM(StateMachine):
         return inode
 
     def _op_truncate(self, ino: int, size: int):
+        self._route_guard(ino)
         inode = self._get_inode(ino)
         shrink = max(0, inode.size - size)
         if shrink:  # credit the quota back for the cut-off span
@@ -464,9 +533,11 @@ class MetaPartitionSM(StateMachine):
         return inode
 
     def _op_set_xattr(self, ino: int, key: str, value: bytes):
+        self._route_guard(ino)
         self._get_inode(ino).xattrs[key] = value
 
     def _op_remove_xattr(self, ino: int, key: str):
+        self._route_guard(ino)
         self._get_inode(ino).xattrs.pop(key, None)
 
     # -- fsm ops: dentries ------------------------------------------------------
@@ -485,6 +556,10 @@ class MetaPartitionSM(StateMachine):
         failure after the TM decision would leave the txn half-applied."""
         key = (parent, name)
         if not _committing:
+            # 2PC commit replays skip the guard: prepare already ran it, and
+            # freeze_range refuses while prepared txns exist — a commit can
+            # never land in a frozen sub-range, and commits cannot fail
+            self._route_guard(parent)
             self._check_lock(("d", parent, name), _tx)
             self._check_lock(("c", parent), _tx)  # dir-delete freezes the child set
         if key in self.dentries:
@@ -507,6 +582,7 @@ class MetaPartitionSM(StateMachine):
                           _tx: str | None = None, _committing: bool = False):
         key = (parent, name)
         if not _committing:
+            self._route_guard(parent)
             self._check_lock(("d", parent, name), _tx)
         d = self.dentries.get(key)
         if d is None:
@@ -545,6 +621,8 @@ class MetaPartitionSM(StateMachine):
         displaced_ino == 0 means nothing was displaced and displaced_nlink
         == -1 means the displaced inode lives in another partition (the
         client must unlink it via the per-op flow)."""
+        self._route_guard(src_parent)
+        self._route_guard(dst_parent)
         self._check_lock(("d", src_parent, src_name))
         self._check_lock(("d", dst_parent, dst_name))
         d = self.dentries.get((src_parent, src_name))
@@ -571,7 +649,9 @@ class MetaPartitionSM(StateMachine):
             self._op_delete_dentry(dst_parent, dst_name,
                                    quota_ids=dst_quota_ids)
             displaced_ino = displaced.ino
-            if self.owns_ino(displaced.ino) and displaced.ino in self.inodes:
+            # owns_ino_live: a displaced inode in the FROZEN sub-range is
+            # the sibling's to unlink (client per-op flow re-routes there)
+            if self.owns_ino_live(displaced.ino) and displaced.ino in self.inodes:
                 displaced_nlink = self._op_unlink_inode(displaced.ino).nlink
         self._op_create_dentry(dst_parent, dst_name, d.ino, d.mode,
                                quota_ids=dst_quota_ids)
@@ -580,6 +660,11 @@ class MetaPartitionSM(StateMachine):
                 displaced_nlink, displaced_is_dir)
 
     def _op_link(self, parent: int, name: str, ino: int):
+        self._route_guard(parent)
+        if self.owns_ino(ino):
+            # the nlink bump mutates the inode: fence it during a split
+            # (a cross-partition link's nlink is the caller's contract)
+            self._route_guard(ino)
         inode = self._get_inode(ino)
         if inode.is_dir:
             raise MetaError("hardlink to directory")
@@ -654,6 +739,10 @@ class MetaPartitionSM(StateMachine):
             if op not in self.TX_OPS:
                 raise MetaError(f"op {op!r} not transactable")
             args = dict(args)
+            # a prepare landing in the frozen sub-range must conflict NOW:
+            # freeze_range refuses while txns exist, so without this guard a
+            # post-freeze prepare could commit into entries mid-copy
+            self._route_guard(args["parent"])
             # dry-run validation so commit CANNOT fail later: every check the
             # commit replay would make must run (and conflict) here
             if op == "create_dentry":
@@ -768,6 +857,203 @@ class MetaPartitionSM(StateMachine):
             return "prepared"
         return "unknown"
 
+    # -- fsm ops: mid-range load split (ISSUE 15) -------------------------------
+    #
+    # Master-orchestrated: freeze_range fences the sub-range (every op routed
+    # there raises WrongPartition), export_range pages a CONSISTENT snapshot
+    # of the frozen entries (frozen = immutable by construction),
+    # import_entries loads them into the sibling raft group, the master then
+    # swaps the volume view in ONE master-raft commit (the atomicity point:
+    # before it the sub-range is owned — frozen — by this partition, after it
+    # by the sibling; never by zero or two), and complete_split drops the
+    # moved entries + shrinks `end`. Every step is idempotent, and split_info
+    # is REPLICATED state reported via heartbeats — a crashed orchestrator or
+    # restarted replica resumes the split from the partition's own record.
+
+    EXPORT_BATCH = 256
+
+    def split_point(self) -> int:
+        """Median live inode — the split_at candidate (leader read). 0 when
+        the partition cannot split: fewer than two live inodes, or a median
+        that would leave one side empty."""
+        inos = sorted(self.inodes)
+        if len(inos) < 2:
+            return 0
+        m = inos[len(inos) // 2]
+        if m <= inos[0] or m <= self.start or m >= self.end:
+            return 0
+        return m
+
+    def _op_freeze_range(self, split_at: int, new_pid: int,
+                         new_peers: list[int] | None = None):
+        if self.frozen_from is not None:
+            if self.frozen_from == split_at and self.split_info \
+                    and self.split_info.get("new_pid") == int(new_pid):
+                return dict(self.split_info)  # idempotent re-freeze (resume)
+            raise SplitRefused(
+                f"partition {self.partition_id} already splitting at "
+                f"{self.frozen_from}")
+        if not (self.start < split_at < self.end):
+            raise SplitRefused(
+                f"split_at {split_at} outside ({self.start}, {self.end})")
+        if self.txns:
+            # a prepared 2PC txn may commit into the moving sub-range, and
+            # commits can NEVER fail — refuse; the sweep retries after the
+            # txns resolve (seconds, bounded by TX_TTL)
+            raise TxConflict(
+                f"{len(self.txns)} prepared txn(s) in flight; retry split")
+        self.frozen_from = split_at
+        self.split_info = {"split_at": int(split_at), "new_pid": int(new_pid),
+                           "new_peers": [int(p) for p in (new_peers or [])]}
+        return dict(self.split_info)
+
+    def _op_unfreeze_range(self):
+        """Abort path: lift the fence without moving anything."""
+        self.frozen_from, self.split_info = None, None
+        return None
+
+    def _op_set_range_end(self, end: int):
+        """Shrink this partition's range end (the SM half of a CURSOR split:
+        the master's view commit caps the old tail at split_at, and without
+        this the SM would keep end=INF and allocate inodes BEYOND its view
+        range — unroutable files). Never below the allocation cursor (live
+        inos <= cursor by construction), refused mid-split. A request at or
+        above the current end returns the EXISTING cap unchanged: a sweep
+        retrying a cursor split whose view commit failed recomputes
+        split_at from a cursor that has since advanced, so the recomputed
+        cap overshoots the committed one — the caller must complete the
+        view swap at the cap this op RETURNS, or the tail could never
+        split again once the cursor fills the headroom."""
+        if self.frozen_from is not None:
+            raise SplitRefused(
+                f"partition {self.partition_id} mid-split; range is frozen")
+        if end >= self.end:
+            return self.end
+        if end <= self.start or end <= self.cursor:
+            raise SplitRefused(
+                f"range end {end} would cut live inos "
+                f"(start {self.start}, cursor {self.cursor})")
+        self.end = end
+        return end
+
+    def export_range(self, after: int = 0, limit: int = 0) -> dict:
+        """One page of the frozen sub-range (leader read): inode wires plus
+        each inode's child dentries, ino-ordered. The first page (after=0)
+        also carries the allocation cursor and quota definitions the sibling
+        inherits. Every dentry's parent inode lives in this partition
+        (create_dentry routes by parent), so paging by parent ino covers
+        the dentry set exactly."""
+        if self.frozen_from is None:
+            raise SplitRefused(f"partition {self.partition_id} not frozen")
+        limit = limit or self.EXPORT_BATCH
+        inos = sorted(i for i in self.inodes
+                      if i >= self.frozen_from and i > after)
+        page = inos[:limit]
+        dentries = []
+        for ino in page:
+            dentries += [[d.parent, d.name, d.ino, d.mode]
+                         for d in self.children.get(ino, {}).values()]
+        out = {"inodes": [self._inode_wire(self.inodes[i]) for i in page],
+               "dentries": dentries,
+               "next": page[-1] if page else after,
+               "done": len(inos) <= limit}
+        if not after:
+            out["cursor"] = self.cursor
+            out["quotas"] = {qid: {"max_files": q.get("max_files", 0),
+                                   "max_bytes": q.get("max_bytes", 0)}
+                             for qid, q in self.quotas.items()}
+        return out
+
+    def _op_import_entries(self, inodes: list, dentries: list,
+                           cursor: int | None = None,
+                           quotas: dict | None = None,
+                           final: bool = True):
+        """Load one exported page into the sibling (keyed upserts, so a
+        resumed orchestrator may replay pages). Quota usage is RECOUNTED
+        from the imported entries on the FINAL page only (the sibling does
+        not serve its range until the view swap, so intermediate counts are
+        unobservable, and a per-page recount would make the copy
+        O(n^2/batch) on the apply thread); the recount is idempotent, so
+        replays can't double-charge, and the source sheds the moved usage
+        the same way in complete_split, so volume aggregates conserve."""
+        for rec in inodes:
+            i = self._inode_unwire(rec)
+            if not self.owns_ino(i.ino):
+                raise SplitRefused(
+                    f"import ino {i.ino} outside [{self.start}, {self.end})")
+            self.inodes[i.ino] = i
+        for parent, name, ino, mode in dentries:
+            d = Dentry(parent, name, ino, mode)
+            self.dentries[(parent, name)] = d
+            self.children.setdefault(parent, {})[name] = d
+        if cursor is not None:
+            # inherit the source's allocation cursor: live inos <= cursor,
+            # and the free tail (cursor, end) now allocates HERE
+            self.cursor = max(self.cursor, int(cursor))
+        for qid, q in (quotas or {}).items():
+            dst = self.quotas.setdefault(
+                int(qid), {"files": 0, "bytes": 0, "exceeded": False})
+            dst["max_files"] = q.get("max_files", 0)
+            dst["max_bytes"] = q.get("max_bytes", 0)
+        if final:
+            self._recount_quotas()
+        return len(inodes)
+
+    def _op_complete_split(self):
+        """Cleanup tail step, AFTER the master's view swap: drop the moved
+        entries and shrink `end` to the split point. Idempotent — completing
+        an unfrozen partition is a no-op (resume may retry). Orphans and
+        del_extents keep draining here: their inodes already left the
+        namespace and the purge is location-addressed."""
+        if self.frozen_from is None:
+            return 0
+        cut = self.frozen_from
+        dropped = [i for i in self.inodes if i >= cut]
+        for i in dropped:
+            del self.inodes[i]
+            self.children.pop(i, None)
+        for k in [k for k, d in self.dentries.items() if d.parent >= cut]:
+            del self.dentries[k]
+        self.end = cut
+        self.frozen_from, self.split_info = None, None
+        # shed the moved entries' quota usage: without this their later
+        # deletion debits the SIBLING (which the import recounted), the
+        # max(0,..) clamp eats the debit there, and this side's stale
+        # charge never releases — headroom leaks every split+delete cycle
+        self._recount_quotas()
+        return len(dropped)
+
+    def _recount_quotas(self) -> None:
+        """Rebuild quota usage counters from live entries (split paths only:
+        import pages and complete). Deterministic over replicated SM state
+        and idempotent, so page replays by a resumed orchestrator are safe.
+        The derivation matches how charges/debits are attributed at op time:
+        files per dentry under the PARENT dir inode's __quota_ids__ xattr
+        (dentries live on the parent's partition, and the client resolves
+        quota_ids from that same xattr), bytes per non-dir inode's own
+        xattr times its size (released at evict, so un-evicted orphans
+        stay counted — matching the charge they still hold)."""
+        if not self.quotas:
+            return
+        for q in self.quotas.values():
+            q["files"] = 0
+            q["bytes"] = 0
+        for d in self.dentries.values():
+            parent = self.inodes.get(d.parent)
+            if parent is None:
+                continue
+            for qid in self._inode_quota_ids(parent):
+                q = self.quotas.get(qid)
+                if q is not None:
+                    q["files"] += 1
+        for inode in self.inodes.values():
+            if inode.is_dir or not inode.size:
+                continue
+            for qid in self._inode_quota_ids(inode):
+                q = self.quotas.get(qid)
+                if q is not None:
+                    q["bytes"] += inode.size
+
     # -- fsm ops: quotas (metanode quota + master_quota_manager) ----------------
     #
     # A quota id names a directory subtree. Definitions are fanned out to every
@@ -866,15 +1152,18 @@ class MetaPartitionSM(StateMachine):
         return inode
 
     def get_inode(self, ino: int) -> Inode:
+        self._route_guard(ino)
         return self._get_inode(ino)
 
     def lookup(self, parent: int, name: str) -> Dentry:
+        self._route_guard(parent)
         d = self.dentries.get((parent, name))
         if d is None:
             raise NoEntry(f"{name!r} in {parent}")
         return d
 
     def read_dir(self, parent: int) -> list[Dentry]:
+        self._route_guard(parent)
         self._get_inode(parent)
         return sorted(self.children.get(parent, {}).values(), key=lambda d: d.name)
 

@@ -47,10 +47,12 @@ from chubaofs_tpu.utils.exporter import registry
 
 # ops served from leader state without a raft round (metanode read path)
 READ_OPS = {"lookup", "get_inode", "read_dir", "multipart_get",
-            "multipart_list", "quota_usage", "tx_status", "dump_namespace"}
+            "multipart_list", "quota_usage", "tx_status", "dump_namespace",
+            "split_point", "export_range"}
 
 _ADMIN_OPS = {"admin_create_partition", "admin_remove_partition",
-              "admin_raft_config", "admin_partitions"}
+              "admin_raft_config", "admin_partitions",
+              "admin_partition_leaders"}
 
 
 def _op_label(op: str) -> str:
@@ -165,6 +167,12 @@ class MetaService:
             if op == "admin_partitions":
                 out = sorted(self.metanode.partitions)
                 return pkt.reply(RES_OK, data=json.dumps(out).encode())
+            if op == "admin_partition_leaders":
+                # pid -> whether THIS node currently leads its raft group
+                # (the meta-scale bench's leader-spread evidence)
+                out = {pid: self.metanode.is_leader(pid)
+                       for pid in sorted(self.metanode.partitions)}
+                return pkt.reply(RES_OK, data=json.dumps(out).encode())
             if op in READ_OPS:
                 out = getattr(self.metanode, op)(pid, **args)
             else:
@@ -273,6 +281,19 @@ class RemoteMetaNode:
 
     def dump_namespace(self, partition_id: int):
         return self._call(partition_id, "dump_namespace")
+
+    def split_point(self, partition_id: int) -> int:
+        return self._call(partition_id, "split_point")
+
+    def export_range(self, partition_id: int, after: int = 0,
+                     limit: int = 0) -> dict:
+        return self._call(partition_id, "export_range", after=after,
+                          limit=limit)
+
+    def partition_leaders(self) -> dict[int, bool]:
+        """pid -> is_leader on this node (admin; pid 0 addresses the node)."""
+        out = self._call(0, "admin_partition_leaders")
+        return {int(k): bool(v) for k, v in out.items()}
 
     def close(self):
         self._drop_conn()

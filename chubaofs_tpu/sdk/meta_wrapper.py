@@ -3,9 +3,17 @@
 Reference counterpart: sdk/meta (meta.py:113-121 MetaWrapper with an
 inode-range btree, api.go Create_ll/Lookup_ll/InodeGet_ll, operation.go's
 retry/leader-switch). Routing: an inode belongs to the partition whose
-[start, end) contains it; new inodes are created on the TAIL partition (the one
-with the open range). Every op retries across the partition's peers until it
-finds the leader.
+[start, end) contains it; new inodes are created on the partition owning the
+parent when it can still allocate (the combined-commit fast path), else on
+the TAIL partition (the open range). Every op retries across the partition's
+peers until it finds the leader.
+
+Refresh-safe under splits (ISSUE 15): the view is CACHED (VIEW_TTL, with a
+bisect index over partition starts so routing stays O(log n) at hundreds of
+partitions) and a `EWRONGPART` reply — the metanode's "your view is stale /
+this sub-range is frozen mid-split" signal — triggers a view refresh +
+re-route instead of failing the op; mid-split the re-route loop rides the
+same retry window the leader-switch path uses.
 """
 
 from __future__ import annotations
@@ -18,6 +26,11 @@ from chubaofs_tpu.raft.server import NotLeaderError
 
 
 class MetaWrapper:
+    # cached-view lifetime: error-driven refresh (EWRONGPART) catches splits
+    # the instant they matter, so the TTL only bounds how long COLD routing
+    # data (new partitions a client never erred on) stays unseen
+    VIEW_TTL = 5.0
+
     def __init__(self, master, metanodes: dict[int, MetaNode], volume: str):
         import itertools
         import uuid
@@ -30,20 +43,62 @@ class MetaWrapper:
         # instead of double-applying — which is what makes EIO retries safe
         self.client_id = uuid.uuid4().hex[:16]
         self._uniq = itertools.count(1)
+        self._cached_view: VolumeView | None = None
+        self._view_expire = 0.0
+        # bisect index rebuilt with the cache: (starts[], mps[]) published as
+        # ONE tuple — a MetaWrapper is shared across threads (objectnode
+        # serves one cached FsClient from many evloop workers), and a reader
+        # must never pair a new starts list with an old mps list
+        self._route: tuple[list[int], list[MetaPartitionView]] = ([], [])
+        # partitions that answered ERANGE (inode range exhausted): skip
+        # their combined-create fast path until a refresh shows otherwise
+        self._full_pids: set[int] = set()
 
     # -- routing ---------------------------------------------------------------
 
     def _view(self) -> VolumeView:
-        return self.master.get_volume(self.volume)
+        now = time.monotonic()
+        if self._cached_view is None or now >= self._view_expire:
+            return self.refresh_view()
+        return self._cached_view
+
+    def refresh_view(self) -> VolumeView:
+        """Re-fetch the volume view and rebuild the routing index. In-process
+        the view object is the master's LIVE state (mutated in place by raft
+        apply), so the rebuild re-snapshots the partition list; remotely it
+        is a fresh HTTP fetch."""
+        view = self.master.get_volume(self.volume)
+        mps = sorted(view.meta_partitions, key=lambda m: m.start)
+        self._route = ([m.start for m in mps], mps)  # atomic publish
+        self._cached_view = view
+        self._view_expire = time.monotonic() + self.VIEW_TTL
+        self._full_pids.clear()
+        return view
 
     def partition_of(self, ino: int) -> MetaPartitionView:
-        for mp in self._view().meta_partitions:
-            if mp.start <= ino < mp.end:
-                return mp
+        """The partition owning `ino`: one bisect over the cached start
+        index (O(log n) at hundreds of partitions), with a containment
+        re-check — a stale index (split since the last rebuild) misses, and
+        ONE refresh re-routes before giving up."""
+        import bisect
+
+        self._view()  # ensure the cache is built / TTL-fresh
+        for _ in range(2):
+            starts, mps = self._route  # one read: starts stays aligned
+            i = bisect.bisect_right(starts, ino) - 1
+            if i >= 0:
+                mp = mps[i]
+                # containment re-check: in-process the cached mp objects are
+                # LIVE (a split shrank mp.end in place), so a stale index
+                # still answers correctly or falls through to the refresh
+                if mp.start <= ino < mp.end:
+                    return mp
+            self.refresh_view()
         raise MasterError(f"no partition owns inode {ino}")
 
     def tail_partition(self) -> MetaPartitionView:
-        return self._view().meta_partitions[-1]
+        self._view()
+        return self._route[1][-1]
 
     # -- leader-retry op execution ---------------------------------------------
 
@@ -123,48 +178,109 @@ class MetaWrapper:
             finally:
                 span.append_track_log("meta", err=err)
 
+    # -- split-safe routed execution -------------------------------------------
+    #
+    # EWRONGPART is the metanode's "this partition no longer (or not yet)
+    # serves that inode" reply: the view is stale (a split swapped ownership)
+    # or the sub-range is frozen mid-split. Nothing was mutated (the route
+    # guard is a pre-check), so the op refreshes the view, re-routes, and
+    # retries — once immediately for the common post-swap case, then inside
+    # the same bounded window the leader-retry path uses for the brief
+    # freeze-to-swap gap.
+
+    def _retry_stale_view(self, attempt, codes: tuple = ("EWRONGPART",)):
+        """Run attempt() to completion through stale-route errors: on a code
+        in `codes`, refresh the view and retry — once immediately (the
+        common post-swap case), then polling each RETRY_SLEEP inside the
+        bounded RETRY_WINDOW for the brief freeze-to-swap gap. `attempt`
+        re-resolves its own routing per call, so every retry runs against
+        the refreshed view. The ONE retry policy for every routed op."""
+        deadline = time.monotonic() + self.RETRY_WINDOW
+        first = True
+        while True:
+            try:
+                return attempt()
+            except OpError as e:
+                if e.code not in codes:
+                    raise
+                if not first:
+                    if time.monotonic() >= deadline:
+                        raise
+                    time.sleep(self.RETRY_SLEEP)
+                first = False
+                self.refresh_view()
+
+    def _routed_submit(self, route_ino: int, op: str, **args):
+        return self._retry_stale_view(
+            lambda: self.submit(self.partition_of(route_ino), op, **args))
+
+    def _routed_read(self, route_ino: int, fn):
+        """fn(metanode, mp) on the owning partition's leader, re-routing on
+        EWRONGPART like _routed_submit."""
+        def attempt():
+            mp = self.partition_of(route_ino)
+            return self._on_partition(mp, lambda n, _mp=mp: fn(n, _mp))
+
+        return self._retry_stale_view(attempt)
+
     # -- the ll API (api.go analogs) -------------------------------------------
 
     def create_inode(self, mode: int, uid: int = 0, gid: int = 0,
                      quota_ids: list[int] | None = None):
-        mp = self.tail_partition()
-        return self.submit(mp, "create_inode", mode=mode, uid=uid, gid=gid,
-                           quota_ids=quota_ids or [])
+        """Allocate on the tail partition (the open range), refreshing
+        through splits: ERANGE = the cached tail filled and split (cursor
+        growth), EWRONGPART = the tail is mid-load-split and its free range
+        is moving to the sibling — both re-route to the CURRENT tail."""
+        return self._retry_stale_view(
+            lambda: self.submit(self.tail_partition(), "create_inode",
+                                mode=mode, uid=uid, gid=gid,
+                                quota_ids=quota_ids or []),
+            codes=("ERANGE", "EWRONGPART"))
 
     def create_file(self, parent: int, name: str, mode: int,
                     quota_ids: list[int] | None = None):
-        """Inode + dentry in one commit when the parent's partition is also
-        the inode-allocating (tail) partition — the common case, since the
-        tail holds every recently-created directory. Falls back to the
-        two-op flow (with its undo-on-conflict contract handled by the
-        CALLER, as FsClient does) across partitions. Returns the inode."""
-        # ONE view fetch (a master RPC in remote mode) decides both roles —
-        # two fetches could disagree across a concurrent tail split
-        mps = self._view().meta_partitions
-        tail = mps[-1]
-        if tail.start <= parent < tail.end:
-            return self.submit(tail, "create_inode_dentry", parent=parent,
-                               name=name, mode=mode,
-                               quota_ids=quota_ids or [])
-        return None  # caller takes the two-op path
+        """Inode + dentry in ONE commit when the partition owning the parent
+        can still allocate inodes — always true on the tail (the open
+        range), and true on cursor-split/load-split siblings until their
+        bounded range fills. Falls back to the two-op flow (undo-on-conflict
+        handled by the CALLER, as FsClient does) by returning None: on
+        ERANGE the partition is remembered as full (skipped until the next
+        view refresh), on EWRONGPART the view refreshes and the fast path
+        RE-CHECKS against the new routing — a split between the route and
+        the submit must not silently demote every create to two ops."""
+        def attempt():
+            mp = self.partition_of(parent)
+            if mp.partition_id in self._full_pids:
+                return None  # known-exhausted: caller takes the two-op path
+            try:
+                return self.submit(mp, "create_inode_dentry", parent=parent,
+                                   name=name, mode=mode,
+                                   quota_ids=quota_ids or [])
+            except OpError as e:
+                if e.code == "ERANGE":
+                    self._full_pids.add(mp.partition_id)
+                    return None
+                raise
+
+        return self._retry_stale_view(attempt)
 
     def create_dentry(self, parent: int, name: str, ino: int, mode: int,
                       quota_ids: list[int] | None = None):
-        mp = self.partition_of(parent)
-        return self.submit(mp, "create_dentry", parent=parent, name=name,
-                           ino=ino, mode=mode, quota_ids=quota_ids or [])
+        return self._routed_submit(parent, "create_dentry", parent=parent,
+                                   name=name, ino=ino, mode=mode,
+                                   quota_ids=quota_ids or [])
 
     def lookup(self, parent: int, name: str):
-        mp = self.partition_of(parent)
-        return self._on_partition(mp, lambda n: n.lookup(mp.partition_id, parent, name))
+        return self._routed_read(
+            parent, lambda n, mp: n.lookup(mp.partition_id, parent, name))
 
     def get_inode(self, ino: int):
-        mp = self.partition_of(ino)
-        return self._on_partition(mp, lambda n: n.get_inode(mp.partition_id, ino))
+        return self._routed_read(
+            ino, lambda n, mp: n.get_inode(mp.partition_id, ino))
 
     def read_dir(self, parent: int):
-        mp = self.partition_of(parent)
-        return self._on_partition(mp, lambda n: n.read_dir(mp.partition_id, parent))
+        return self._routed_read(
+            parent, lambda n, mp: n.read_dir(mp.partition_id, parent))
 
     def remove_entry(self, parent: int, name: str, want_dir: bool,
                      quota_ids: list[int] | None = None):
@@ -172,11 +288,11 @@ class MetaWrapper:
         when the parent's partition also owns the child inode; returns
         (ino, nlink_after) or None when the child lives in another
         partition (caller falls back to the per-op flow)."""
-        mp = self.partition_of(parent)
         try:
-            res = self.submit(mp, "delete_dentry_unlink", parent=parent,
-                              name=name, want_dir=want_dir,
-                              quota_ids=quota_ids or [])
+            res = self._routed_submit(parent, "delete_dentry_unlink",
+                                      parent=parent, name=name,
+                                      want_dir=want_dir,
+                                      quota_ids=quota_ids or [])
         except OpError as e:
             if e.code == "EXDEVPART":
                 return None
@@ -185,33 +301,28 @@ class MetaWrapper:
 
     def delete_dentry(self, parent: int, name: str,
                       quota_ids: list[int] | None = None):
-        mp = self.partition_of(parent)
-        return self.submit(mp, "delete_dentry", parent=parent, name=name,
-                           quota_ids=quota_ids or [])
+        return self._routed_submit(parent, "delete_dentry", parent=parent,
+                                   name=name, quota_ids=quota_ids or [])
 
     def unlink_inode(self, ino: int):
-        mp = self.partition_of(ino)
-        return self.submit(mp, "unlink_inode", ino=ino)
+        return self._routed_submit(ino, "unlink_inode", ino=ino)
 
     def evict_inode(self, ino: int):
-        mp = self.partition_of(ino)
-        return self.submit(mp, "evict_inode", ino=ino)
+        return self._routed_submit(ino, "evict_inode", ino=ino)
 
     def update_inode(self, ino: int, **kw):
-        mp = self.partition_of(ino)
-        return self.submit(mp, "update_inode", ino=ino, **kw)
+        return self._routed_submit(ino, "update_inode", ino=ino, **kw)
 
     def truncate(self, ino: int, size: int):
-        mp = self.partition_of(ino)
-        return self.submit(mp, "truncate", ino=ino, size=size)
+        return self._routed_submit(ino, "truncate", ino=ino, size=size)
 
     def append_extents(self, ino: int, extents: list[dict], size: int):
-        mp = self.partition_of(ino)
-        return self.submit(mp, "append_extents", ino=ino, extents=extents, size=size)
+        return self._routed_submit(ino, "append_extents", ino=ino,
+                                   extents=extents, size=size)
 
     def append_obj_extents(self, ino: int, locations: list[dict], size: int):
-        mp = self.partition_of(ino)
-        return self.submit(mp, "append_obj_extents", ino=ino, locations=locations, size=size)
+        return self._routed_submit(ino, "append_obj_extents", ino=ino,
+                                   locations=locations, size=size)
 
     TX_TTL = 30.0  # prepared-txn lifetime before peers self-resolve
 
@@ -221,7 +332,18 @@ class MetaWrapper:
         """POSIX replace semantics: an existing destination is displaced.
         Returns (displaced_ino, displaced_nlink, displaced_is_dir) when a
         destination was displaced (the caller owns its orphan/evict
-        contract), else None."""
+        contract), else None. A stale-view EWRONGPART (split mid-rename)
+        restarts the whole flow on the refreshed view: the local-vs-2PC
+        decision itself depends on the routing, so per-op re-route is not
+        enough."""
+        return self._retry_stale_view(
+            lambda: self._rename_once(src_parent, src_name, dst_parent,
+                                      dst_name, src_quota_ids,
+                                      dst_quota_ids))
+
+    def _rename_once(self, src_parent: int, src_name: str, dst_parent: int,
+                     dst_name: str, src_quota_ids: list[int] | None = None,
+                     dst_quota_ids: list[int] | None = None):
         import stat as stat_mod
 
         src_mp = self.partition_of(src_parent)
@@ -348,10 +470,9 @@ class MetaWrapper:
         """Emptiness as seen by the partition that OWNS the directory's
         inode — a dir's child dentries route by the dir's ino, so a check on
         the dst dentry's partition is blind to children living elsewhere."""
-        mp = self.partition_of(ino)
         try:
-            return bool(self._on_partition(
-                mp, lambda n: n.read_dir(mp.partition_id, ino)))
+            return bool(self._routed_read(
+                ino, lambda n, mp: n.read_dir(mp.partition_id, ino)))
         except OpError as e:
             if e.code == "ENOENT":
                 return False  # inode already gone: nothing to orphan
@@ -433,16 +554,15 @@ class MetaWrapper:
                             exceeded=exceeded)
 
     def link(self, parent: int, name: str, ino: int):
-        mp = self.partition_of(parent)
-        return self.submit(mp, "link", parent=parent, name=name, ino=ino)
+        return self._routed_submit(parent, "link", parent=parent, name=name,
+                                   ino=ino)
 
     def set_xattr(self, ino: int, key: str, value: bytes):
-        mp = self.partition_of(ino)
-        return self.submit(mp, "set_xattr", ino=ino, key=key, value=value)
+        return self._routed_submit(ino, "set_xattr", ino=ino, key=key,
+                                   value=value)
 
     def remove_xattr(self, ino: int, key: str):
-        mp = self.partition_of(ino)
-        return self.submit(mp, "remove_xattr", ino=ino, key=key)
+        return self._routed_submit(ino, "remove_xattr", ino=ino, key=key)
 
     # -- S3 multipart sessions (metanode multipart state, objectnode's backing) --
     # upload_id embeds the owning partition so later ops route without a
@@ -460,9 +580,13 @@ class MetaWrapper:
             pid = int(upload_id.split(".", 1)[0])
         except ValueError:
             raise OpError("ENOENT", f"malformed upload id {upload_id!r}") from None
-        for mp in self._view().meta_partitions:
-            if mp.partition_id == pid:
-                return mp
+        for fresh in (False, True):
+            view = self.refresh_view() if fresh else self._view()
+            for mp in view.meta_partitions:
+                if mp.partition_id == pid:
+                    return mp
+            # cached view may predate the partition (a just-split sibling):
+            # one refresh before declaring the upload gone
         raise OpError("ENOENT", f"partition {pid} for upload {upload_id}")
 
     def multipart_put_part(self, upload_id: str, part_num: int, location: dict):

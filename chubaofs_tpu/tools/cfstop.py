@@ -22,6 +22,11 @@ one row per daemon target:
   * THR% — QoS throttled-request share over the window
     (`cfs_objectnode_throttled` / `cfs_objectnode_requests` deltas; '-'
     when the target saw no shaped requests);
+  * META — metadata plane: partitions hosted (`cfs_metanode_partitions`
+    gauge) and the hottest single partition's ops/s over the window (max
+    per-pid delta of `cfs_metanode_partition_ops{pid}` / dt — the
+    load-split signal), rendered `parts/hot`; '-' when the target hosts
+    no meta partitions;
   * REPAIRQ — repair tasks outstanding (`cfs_scheduler_tasks` gauge sum);
   * UP — seconds since the daemon's `cfs_boot_time_seconds` boot stamp. A
     boot stamp that MOVED between frames is a confirmed restart — the row
@@ -51,7 +56,8 @@ from chubaofs_tpu.utils.metrichist import (
 from chubaofs_tpu.utils.slo import FAILING, RANK
 
 COLUMNS = ("TARGET", "SLO", "UP", "PUT/S", "GET/S", "PUT99MS", "CONNS",
-           "BP/S", "LAG99", "CODEC/B", "CACHE%", "THR%", "REPAIRQ", "ALERTS")
+           "BP/S", "LAG99", "CODEC/B", "CACHE%", "THR%", "META", "REPAIRQ",
+           "ALERTS")
 
 
 # -- scraping ------------------------------------------------------------------
@@ -146,6 +152,24 @@ def _p99(prev: dict, cur: dict, family: str) -> float | None:
     return hist_quantile(buckets, count, 0.99)
 
 
+def _hottest_pid_rate(prev: dict, cur: dict, dt: float) -> float:
+    """Max per-partition window rate of cfs_metanode_partition_ops{pid} —
+    per-SERIES deltas (not family_sum: the hottest partition is the split
+    signal, and summing would hide the skew), restart-clamped like every
+    flow cell."""
+    if dt <= 0:
+        return 0.0
+    best = 0.0
+    for k, v in cur.items():
+        if parse_key(k)[0] != "cfs_metanode_partition_ops":
+            continue
+        d = v - prev.get(k, 0.0)
+        if d < 0:
+            d = v  # counter restarted: the post-restart total is the window
+        best = max(best, d / dt)
+    return round(best, 2)
+
+
 def compute_row(target: str, prev: dict | None, cur: dict | None,
                 dt: float, health: dict | None) -> dict:
     """One dashboard row from two metric snapshots of one target."""
@@ -162,6 +186,8 @@ def compute_row(target: str, prev: dict | None, cur: dict | None,
             row["unreachable"] = True
         return row
     # state gauges read from the current frame alone
+    parts = family_sum(cur, "cfs_metanode_partitions")
+    row["meta_parts"] = int(parts) if parts > 0 else None
     row["conns"] = int(family_sum(cur, "cfs_evloop_conns"))
     row["repair_q"] = int(family_sum(cur, "cfs_scheduler_tasks"))
     row["alerts"] = int(family_sum(cur, "cfs_alerts_firing"))
@@ -208,6 +234,11 @@ def compute_row(target: str, prev: dict | None, cur: dict | None,
     reqs = _rate(prev, cur, "cfs_objectnode_requests", 1.0)
     thr = _rate(prev, cur, "cfs_objectnode_throttled", 1.0)
     row["thr_pct"] = round(100.0 * thr / reqs, 1) if reqs > 0 else None
+    # metadata plane (ISSUE 15): the hottest single partition's window
+    # ops/s (the load-split signal); partitions-hosted is a state gauge
+    # above, so a metanode's first frame still renders `N/-`
+    row["meta_hot_ops"] = _hottest_pid_rate(prev, cur, dt) \
+        if row.get("meta_parts") else None
     return row
 
 
@@ -230,6 +261,14 @@ def _cell(v) -> str:
     return f"{v:g}" if isinstance(v, float) else str(v)
 
 
+def _meta_cell(r: dict) -> str:
+    """META column: `parts/hot-ops` (e.g. `4/123.5`); '-' off-metanodes.
+    hot-ops is '-' on the first frame (no prior to delta against)."""
+    if r.get("meta_parts") is None:
+        return "-"
+    return f"{r['meta_parts']}/{_cell(r.get('meta_hot_ops'))}"
+
+
 def render(rows: list[dict], errors: list[str] = ()) -> str:
     if not rows:
         return "(no targets)" + ("".join(f"\n! {e}" for e in errors))
@@ -243,7 +282,7 @@ def render(rows: list[dict], errors: list[str] = ()) -> str:
               _cell(r.get("put99_ms")), _cell(r.get("conns")),
               _cell(r.get("bp_s")), _cell(r.get("lag99_ms")),
               _cell(r.get("codec_occ")), _cell(r.get("cache_pct")),
-              _cell(r.get("thr_pct")),
+              _cell(r.get("thr_pct")), _meta_cell(r),
               _cell(r.get("repair_q")), _cell(r.get("alerts"))]
              for r in rows]
     widths = [max(len(COLUMNS[i]), max(len(row[i]) for row in cells))

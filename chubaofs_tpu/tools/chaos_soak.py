@@ -44,6 +44,13 @@ def main(argv=None) -> int:
                         "kills one node under live PUT load and FAILS if "
                         "rebuild throughput is zero, any repaired stripe "
                         "miscompares, or a WORKING task is stranded")
+    p.add_argument("--meta-split", action="store_true",
+                   help="run the metadata scale-out chaos soak (ISSUE 15): "
+                        "crash-restart a metanode daemon mid-split and "
+                        "mid-migration under live create load; fails on any "
+                        "acked-file loss, a double-owned inode, an unhealed "
+                        "membership, or a missing split/migrate event "
+                        "timeline")
     p.add_argument("--cache", action="store_true",
                    help="run the cache-plane correctness soak (ISSUE 12): "
                         "zipfian GETs + overwrites + deletes through the "
@@ -66,12 +73,24 @@ def main(argv=None) -> int:
         os.environ["CFS_LOCK_SANITIZER"] = "1"
 
     from chubaofs_tpu.chaos.soak import (
-        SoakFailure, run_cache_soak, run_kill_soak, run_soak)
+        SoakFailure, run_cache_soak, run_kill_soak, run_meta_split_soak,
+        run_soak)
 
     plans = args.plan or (
-        [] if (args.kill_blobnode or args.cache) else ACCEPTANCE_PLANS)
+        [] if (args.kill_blobnode or args.cache or args.meta_split)
+        else ACCEPTANCE_PLANS)
     results = []
     ok = True
+    if args.meta_split:
+        root = (os.path.join(args.root, "meta-split") if args.root
+                else tempfile.mkdtemp(prefix="chaos-meta-"))
+        try:
+            res = run_meta_split_soak(root, seed=args.seed)
+        except SoakFailure as e:
+            ok = False
+            res = {"plan": "meta_split", "seed": args.seed, "ok": False,
+                   "error": str(e)}
+        results.append(res)
     if args.cache:
         root = (os.path.join(args.root, "cache-soak") if args.root
                 else tempfile.mkdtemp(prefix="chaos-cache-"))
@@ -138,6 +157,13 @@ def main(argv=None) -> int:
             status = "OK " if r.get("ok") else "FAIL"
             if not r.get("ok"):
                 extra = r.get("error", "")
+            elif r.get("plan") == "meta_split":
+                extra = (f"parts={r.get('partitions')} "
+                         f"acked={r.get('creates_acked')} "
+                         f"failed={r.get('creates_failed')} "
+                         f"inodes={r.get('inodes_census')} "
+                         f"moved={r.get('migrate_moved')} "
+                         f"kills={[k['phase'] for k in r.get('kills', [])]}")
             elif r.get("plan") == "kill_blobnode":
                 extra = (f"killed={r['killed_node']} "
                          f"detect={r['detect_s']}s "

@@ -130,6 +130,207 @@ def bench_smallfile(cluster, volume: str, n_files: int, size: int = 4096) -> dic
     return out
 
 
+def bench_meta_scale(root: str, volume: str = "metascale", dirs: int = 16,
+                     seed_files: int = 24, files_per_phase: int = 12,
+                     metanodes: int = 9, phases: tuple = (1, 3, 4),
+                     wire_ms: float = 40.0,
+                     workers_per_partition: int = 4) -> dict:
+    """Metadata scale-out proof (ISSUE 15): aggregate create ops/s as ONE
+    volume grows from 1 to >=4 meta partitions spread over >=2 metanode
+    processes, via mid-range LOAD splits at the median live inode.
+
+    Cluster shape: `metanodes` metanode daemons (9, so the measured phases'
+    3-replica partition groups land on DISJOINT node triples — a node
+    hosting two groups serializes their commit rounds through its single
+    raft drain-pump thread, and with only 3 metanodes every node
+    participates in every commit, so partitioning could spread nothing),
+    and a deterministic `wire_ms` delay at the raft.drain
+    failpoint in every daemon — the WAL-fsync + replication RTT every real
+    deployment pays per commit round (bench_put_pipeline's `_wire`
+    rationale: in-process commits cost ~0 wall, so without it there is
+    nothing for partition parallelism to overlap on a shared CI host).
+
+    Methodology: WEAK scaling — client concurrency grows with the partition
+    count (`workers_per_partition` x partitions), the mdtest scale-out
+    convention: a metadata plane that splits exists to serve MORE
+    concurrent clients, and holding the client herd fixed would only
+    re-measure per-client latency. The workload is the directory-heavy
+    tenant of arxiv 1709.05365: `dirs` directories created INTERLEAVED
+    with seed files so the dir inos spread across the inode range (a
+    median split then leaves directories on BOTH sides), parents resolved
+    once (the mdtest cached-handle shape). Between phases
+    /metaPartition/split grows the layout — the same machinery
+    CFS_META_SPLIT_OPS drives from heartbeat loads, triggered explicitly
+    so phase boundaries are deterministic: splitting the TAIL chains a
+    cursor split (dead lower half, headroom-capped hot half, fresh tail),
+    splitting a mid partition adds one. Dirs on allocating partitions keep
+    the combined single-commit path; dirs on dead ranges pay dentry-local
+    + tail-inode two-op commits. Each phase warms one untimed create per
+    dir first (fills the client's full-partition cache so ERANGE probe
+    rounds stay out of the window).
+
+    Correctness gates (the tier-1 smoke): every phase reaches its exact
+    partition count, ranges stay contiguous/disjoint, no duplicate ino is
+    ever handed out, every create lands exactly once (per-dir readdir
+    census), and the final layout has raft leaders on >=2 distinct
+    metanodes. The scaling numbers ride the BENCH json (PERF.md policy:
+    no perf floors in tier-1 on co-tenant CI hosts)."""
+    import stat as stat_mod
+
+    from chubaofs_tpu.meta.service import RemoteMetaNode
+    from chubaofs_tpu.sdk.cluster import RemoteCluster
+    from chubaofs_tpu.testing.harness import ProcCluster
+
+    cluster = ProcCluster(
+        root, masters=1, metanodes=metanodes, datanodes=0,
+        env={"CFS_FAILPOINTS": f"raft.drain=delay({wire_ms / 1000.0})"}
+        if wire_ms > 0 else None)
+    try:
+        return _meta_scale_phases(cluster, volume, dirs, seed_files,
+                                  files_per_phase, phases,
+                                  workers_per_partition,
+                                  RemoteCluster, RemoteMetaNode, stat_mod)
+    finally:
+        cluster.close()
+
+
+def _meta_scale_phases(cluster, volume, dirs, seed_files, files_per_phase,
+                       phases, workers_per_partition,
+                       RemoteCluster, RemoteMetaNode, stat_mod) -> dict:
+    mc = cluster.client_master()
+    mc.create_volume(volume, cold=True)
+    setup_fs = RemoteCluster(cluster.master_addrs).client(volume)
+    expected: dict[int, set] = {d: set() for d in range(dirs)}
+    dir_inos: list[int] = []
+    for d in range(dirs):
+        dir_inos.append(setup_fs.mkdirs(f"/d{d}"))
+        for i in range(seed_files):
+            setup_fs.create(f"/d{d}/seed{i}")
+            expected[d].add(f"seed{i}")
+
+    max_workers = workers_per_partition * phases[-1]
+    fss = []
+    for _ in range(max_workers):
+        fs = RemoteCluster(cluster.master_addrs).client(volume)
+        # the measurement window outlives the default view TTL; routing
+        # refreshes are error-driven (EWRONGPART) during the window, so a
+        # mid-window TTL refresh would only clear the full-partition cache
+        # and re-pay ERANGE probe rounds
+        fs.meta.VIEW_TTL = 300.0
+        fss.append(fs)
+    out: dict = {}
+
+    def mps():
+        return sorted(mc.meta_partitions(volume), key=lambda m: m["start"])
+
+    def split_to(target: int):
+        """Split toward `target` partitions, always splitting the partition
+        holding the MOST measured directories (tie: the highest range —
+        later partitions are the ones with allocation headroom, and
+        splitting those keeps the combined-create path alive)."""
+        while len(mps()) < target:
+            def dirs_in(m):
+                end = m["end"] if m["end"] > 0 else (1 << 63)
+                return sum(1 for ino in dir_inos if m["start"] <= ino < end)
+
+            cands = sorted(mps(), key=lambda m: (-dirs_in(m), -m["start"]))
+            for m in cands:
+                new_pid = mc.split_meta_partition(
+                    volume, m["partition_id"])["new_pid"]
+                if new_pid:
+                    break
+            else:
+                raise RuntimeError("no partition would split "
+                                   f"(view: {mps()})")
+
+    def create_one(fs, parent: int, name: str) -> int:
+        """One create with the parent handle CACHED (no per-create path
+        resolution): the combined single-commit fast path when the parent's
+        partition allocates, else the two-op flow — FsClient._create_node's
+        exact contract, minus the resolve."""
+        mode = stat_mod.S_IFREG | 0o644
+        inode = fs.meta.create_file(parent, name, mode, quota_ids=[])
+        if inode is None:
+            inode = fs.meta.create_inode(mode)
+            fs.meta.create_dentry(parent, name, inode.ino, inode.mode)
+        return inode.ino
+
+    def measure(tag: str, parts: int) -> float:
+        workers = workers_per_partition * parts
+        # warm-up: one untimed create per dir per client herd — routes
+        # refresh, ERANGE probes land in _full_pids, raft leaders settle
+        for d in range(dirs):
+            create_one(fss[d % workers], dir_inos[d], f"{tag}_warm")
+            expected[d].add(f"{tag}_warm")
+        inos: list[list[int]] = [[] for _ in range(workers)]
+
+        def worker(w: int):
+            fs = fss[w]
+            for d in range(w, dirs, workers):
+                parent = dir_inos[d]
+                for i in range(files_per_phase):
+                    inos[w].append(create_one(fs, parent, f"{tag}_{i}"))
+                    expected[d].add(f"{tag}_{i}")
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(workers) as pool:
+            list(pool.map(worker, range(workers)))
+        dt = time.perf_counter() - t0
+        made = dirs * files_per_phase
+        flat = [i for per in inos for i in per]
+        assert len(flat) == made and len(set(flat)) == len(flat), \
+            "duplicate or missing ino"
+        rate = made / dt
+        log(f"  meta-scale {parts}p x{workers}w: {made} creates in "
+            f"{dt:.2f}s = {rate:.1f} ops/s")
+        return rate
+
+    for parts in phases:
+        split_to(parts)
+        view = mps()
+        assert len(view) == parts, (parts, view)
+        # contiguous + disjoint ranges: no ino owned by zero/two partitions
+        for a, b in zip(view, view[1:]):
+            assert a["end"] == b["start"], f"range gap/overlap: {view}"
+        out[f"meta_create_ops_{parts}p"] = round(measure(f"p{parts}", parts), 1)
+
+    # census: every create landed exactly once, across every boundary
+    census_fs = RemoteCluster(cluster.master_addrs).client(volume)
+    for d in range(dirs):
+        names = census_fs.readdir(f"/d{d}")
+        assert len(names) == len(set(names)), f"dup dentries in /d{d}"
+        missing = expected[d] - set(names)
+        extra = set(names) - expected[d]
+        assert not missing and not extra, \
+            f"/d{d}: missing={sorted(missing)[:4]} extra={sorted(extra)[:4]}"
+
+    # leader spread: the final layout's raft leaders live on >=2 metanodes
+    leaders: dict[int, int] = {}
+    for n in mc.get_cluster()["nodes"]:
+        if n["kind"] != "meta" or not n["addr"]:
+            continue
+        h = RemoteMetaNode(n["addr"])
+        try:
+            for pid, is_lead in h.partition_leaders().items():
+                if is_lead:
+                    leaders[pid] = n["node_id"]
+        finally:
+            h.close()
+    view_pids = {m["partition_id"] for m in mps()}
+    lead_nodes = {leaders[pid] for pid in view_pids if pid in leaders}
+    out["meta_leader_nodes"] = len(lead_nodes)
+    assert len(lead_nodes) >= 2, \
+        f"partitions not spread: leaders {leaders} for {sorted(view_pids)}"
+    lo, hi = phases[0], phases[-1]
+    out["meta_scale_speedup"] = round(
+        out[f"meta_create_ops_{hi}p"]
+        / max(0.001, out[f"meta_create_ops_{lo}p"]), 2)
+    log(f"  meta-scale: {lo}p -> {hi}p aggregate create speedup "
+        f"x{out['meta_scale_speedup']}, leaders on "
+        f"{out['meta_leader_nodes']} metanodes")
+    return out
+
+
 def bench_raft_commit(wal_root: str, n_ops: int = 600) -> dict:
     """Raft-commit microbench: single-group commits/s at 1/8/64 concurrent
     proposers — the exact axis the round-5 metadata gap was diagnosed on
@@ -1262,6 +1463,15 @@ def run(root: str, n_files: int = 600, n_clients: int = 4,
         cfg.update(bench_smallfile(cluster, "perf", max(100, n_files // 4)))
     finally:
         cluster.close()
+    # metadata scale-out proof (ISSUE 15): its OWN 9-metanode ProcCluster
+    # (3-replica groups of the 1/3/4-partition phases on disjoint triples)
+    # under the raft-persist wire regime; placed right after the main
+    # cluster phases — before the core-saturating sweeps below — per the
+    # PR-8/12 floor-deflation lesson, so its per-phase A/B (phase-internal
+    # like the others) sees an unthrottled host
+    log("metadata scale-out (1 -> 4 partitions, load splits)...")
+    cfg.update(bench_meta_scale(os.path.join(root, "metascale"),
+                                files_per_phase=max(12, n_files // 50)))
     # the sweep saturates every core for a minute and CPU-throttled hosts
     # recover slowly, so it must run AFTER the cluster phases or their
     # throughput floors deflate ~2x; its own A/B is phase-internal, so

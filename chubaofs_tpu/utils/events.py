@@ -64,6 +64,8 @@ EVENT_TYPES = (
     "tier_promote",         # hot-tier redirect committed
     "tier_demote",          # hot-tier redirect dropped
     "partition_moved",      # master re-homed a partition replica
+    "meta_split",           # mid-range meta split: freeze/commit/complete
+    "meta_migrate",         # meta partition replica add-peer/remove-peer
     "node_decommissioned",  # master drained a node
     "scrub_finding",        # blobnode CRC scrub found bad shards
     "raft_leader",          # a raft group elected this node leader

@@ -172,3 +172,23 @@ def test_qos_fairness_bench_smoke_floor(tmp_path):
     assert out["qos_noisy_served"] > 0, out
     assert out["qos_victim_goodput_ratio"] >= 0.7, out
     assert out["qos_victim_p99_mixed_ms"] > 0, out
+
+
+def test_meta_scale_bench_smoke_floor(tmp_path):
+    """Tier-1 metadata scale-out gate (ISSUE 15): the 1 -> 3 -> 4 partition
+    growth runs end to end over real metanode daemons and every CORRECTNESS
+    gate holds — exact partition counts, contiguous/disjoint ranges, no
+    duplicate ino, per-dir census exact (zero created-file loss across the
+    live splits), leaders on >=2 metanodes. Wired AFTER the ProcCluster
+    phases in perfbench.run() per the PR-8/12 floor-deflation lesson;
+    throughput/monotonicity floors stay in PERF.md, not CI (co-tenant
+    noise policy — this host has 1 core)."""
+    from chubaofs_tpu.tools.perfbench import bench_meta_scale
+
+    out = bench_meta_scale(str(tmp_path), metanodes=4, wire_ms=0.0,
+                           dirs=6, seed_files=4, files_per_phase=3,
+                           workers_per_partition=2)
+    for parts in (1, 3, 4):
+        assert out[f"meta_create_ops_{parts}p"] > 0, out
+    assert out["meta_leader_nodes"] >= 2, out
+    assert out["meta_scale_speedup"] > 0, out
