@@ -671,6 +671,11 @@ class Access:
             size = loc.size - offset
         if offset < 0 or size < 0 or offset + size > loc.size:
             raise AccessError(f"range [{offset}, {offset+size}) outside object of {loc.size}")
+        # read-amp ledger (window bytes the CALLER asked for; the shard
+        # reads below count what the backend actually moved for them —
+        # cfs-top's RDAMP column is the window ratio of the two)
+        registry("access").counter(
+            "read_bytes", {"kind": "requested"}).add(size)
 
         segs = []  # (blob, intra-blob offset, length) the range touches
         pos = 0
@@ -740,8 +745,8 @@ class Access:
         promote threshold are reported to the hot-blob topic, where the
         scheduler's tier sweep copies them into the replica engine."""
         cache = self.cache
-        full = offset == 0 and size == blob.size
         fill_ver = None
+        f_lo, f_len = offset, size
         if cache is not None:
             cached = cache.get(blob.vid, blob.bid, offset, size)
             if cache.promote_signal(blob.vid, blob.bid):
@@ -751,21 +756,31 @@ class Access:
                     pass  # advisory: lost heat re-accumulates next epoch
             if cached is not None and len(cached) == size:
                 return bytes(cached)
-            if full:
-                # version captured BEFORE the backend read: a DELETE racing
-                # this miss invalidates the version and the fill is dropped
-                fill_ver = cache.fill_version(blob.vid, blob.bid)
+            # version captured BEFORE the backend read: a DELETE racing
+            # this miss invalidates the version and the fill is dropped.
+            # The backend window is rounded OUT to cache-block boundaries
+            # (clipped to the blob) so a ranged miss fills exactly the
+            # blocks it touches — the next overlapping range hits.
+            fill_ver = cache.fill_version(blob.vid, blob.bid)
+            blk = cache.block
+            f_lo = (offset // blk) * blk
+            f_len = min(blob.size,
+                        ((offset + size + blk - 1) // blk) * blk) - f_lo
         hot = self.cm.hot_location(blob.vid, blob.bid)
         if hot is not None:
-            data = self._read_blob_hot(hot, offset, size)
+            data = self._read_blob_hot(hot, f_lo, f_len)
             if data is not None:
                 if fill_ver is not None:
-                    cache.fill(blob.vid, blob.bid, fill_ver, data)
-                return data
-        data = self._read_blob_ec(mode, blob, offset, size)
+                    cache.fill(blob.vid, blob.bid, fill_ver, data,
+                               offset=f_lo, total=blob.size)
+                return (data if f_len == size
+                        else data[offset - f_lo: offset - f_lo + size])
+        data = self._read_blob_ec(mode, blob, f_lo, f_len)
         if fill_ver is not None:
-            cache.fill(blob.vid, blob.bid, fill_ver, data)
-        return data
+            cache.fill(blob.vid, blob.bid, fill_ver, data,
+                       offset=f_lo, total=blob.size)
+        return (data if f_len == size
+                else data[offset - f_lo: offset - f_lo + size])
 
     def _read_blob_hot(self, hot: tuple[int, int], offset: int,
                        size: int) -> bytes | None:
@@ -789,6 +804,8 @@ class Access:
             reg.counter("tier_fallbacks").add()
             return None
         reg.counter("tier_hits").add()
+        registry("access").counter(
+            "read_bytes", {"kind": "shards_read"}).add(size)
         return bytes(data)
 
     def _read_blob_ec(self, mode: int, blob: Blob, offset: int, size: int) -> bytes:
@@ -838,7 +855,15 @@ class Access:
             span.add_stage("read", start=t_hop)  # the failed direct attempt
         for f in futs:  # queued laggards must not hold pool workers
             f.cancel()
+        # hand the degraded path everything the direct phase learned: the
+        # sub-range bytes it DID read (reused verbatim — never refetched),
+        # the shards that errored (excluded from the survivor gather), and
+        # the ones that hung (deprioritized, probed asynchronously)
+        have = {i: p for i, p in zip(idxs, pieces) if p is not None}
+        failed_direct = {i for i, p in zip(idxs, pieces)
+                         if p is None and i not in slow}
         return self._read_blob_degraded(t, vol, blob, shard_len, offset, size,
+                                        have=have, failed=failed_direct,
                                         deprioritize=slow)
 
     def _recover_locals_inplace(self, t, vol, blob, stripe, present: list,
@@ -888,7 +913,8 @@ class Access:
                 present.append(g)
 
     def _read_shard(
-        self, vol: VolumeInfo, idx: int, bid: int, offset: int, size: int
+        self, vol: VolumeInfo, idx: int, bid: int, offset: int, size: int,
+        count: bool = True,
     ) -> bytes | None:
         from chubaofs_tpu.blobstore.blobnode import classify_io_error
 
@@ -905,6 +931,11 @@ class Access:
                 registry("access").counter(
                     "read_fail", {"reason": "short"}).add()
                 return None
+            if count:
+                # count=False for background probes: read_amp measures bytes
+                # moved ON BEHALF OF the GET window, not repair-plane sweeps
+                registry("access").counter(
+                    "read_bytes", {"kind": "shards_read"}).add(size)
             return data
         except Exception as e:
             # the caller's contract stays None-on-failure (degraded path
@@ -916,51 +947,66 @@ class Access:
             return None
 
     def _read_blob_degraded(self, t, vol, blob, shard_len, offset, size,
+                            have: dict[int, bytes] | None = None,
+                            failed: set[int] | None = None,
                             deprioritize: set[int] | None = None) -> bytes:
-        """Hedged stripe gather + on-the-fly repair of missing data shards
-        (stream_get.go:427 ReconstructData fallback). The gather keeps
-        `t.read_hedge` (get_quorum-bounded) speculative reads in flight and
-        finishes the moment N shards arrive — stragglers are abandoned, and
-        each FAILED read immediately launches a replacement from the not-yet-
-        tried shards, so one slow or dead blobnode never sets the GET latency
-        floor. `deprioritize` (shards the direct phase saw time out) go LAST
-        so the gather never re-blocks a worker on a known-wedged node first.
-        When the global stripe alone can't reach N survivors and the mode
-        carries local parities, AZ-local stripes are tried next
-        (work_shard_recover.go:517 recoverByLocalStripe applied at READ
-        time). Read-only: durable healing stays with the repair plane via
-        the shard-repair topic."""
+        """Degraded read, range-scoped first: reconstruct ONLY the in-window
+        shards the direct phase could not serve, from a survivor gather over
+        just the window's byte columns (row-sliced decode matrix — decode
+        cost scales with the window, not the stripe). Deep damage — the
+        global stripe can't reach N survivors, so AZ-local parities are
+        needed — falls back to the full-stripe gather, which itself launches
+        only the survivors it selects (never the old `read_hedge`-deep
+        speculative parity fan-out). Read-only: durable healing stays with
+        the repair plane via the shard-repair topic."""
+        have = dict(have or {})
+        slow = set(deprioritize or ())
+        failed = set(failed or ())
+        out = self._degraded_window(t, vol, blob, shard_len, offset, size,
+                                    have, slow, failed)
+        if out is not None:
+            return out
+        return self._degraded_full(t, vol, blob, shard_len, offset, size,
+                                   slow)
+
+    def _gather_survivors(self, vol, bid: int, candidates: list[int],
+                          needed: int, lo: int,
+                          n: int) -> tuple[dict[int, bytes], list[int]]:
+        """Hedged sub-range gather of exactly `needed` shard reads from
+        `candidates` (preference order). Only the reads the selection wants
+        are ever launched — a FAILED read immediately launches the next
+        candidate to keep gather depth, and a read silent past read_deadline
+        launches a hedge replacement while the original keeps running (slow-
+        but-alive may still answer first) — so unselected candidates (the
+        parity tail of the list) are never fetched unless a selected read
+        lets the gather down. Returns (idx -> bytes, failed idxs)."""
         from concurrent.futures import FIRST_COMPLETED, wait
 
-        from chubaofs_tpu.blobstore import trace
-
-        span = trace.current_span()
-        t_gather = time.perf_counter()
-        total = t.N + t.M
-        stripe = np.zeros((total, shard_len), np.uint8)
-        present: list[int] = []
-        failed: list[int] = []
-        slow = deprioritize or set()
-        # data shards first (they skip the matmul); known-wedged ones last
-        order = sorted(range(total), key=lambda i: (i in slow, i))
-        now = time.monotonic()
+        got: dict[int, bytes] = {}
+        failures: list[int] = []
+        if needed <= 0:
+            return got, failures
         pending: dict = {}
         launched: dict = {}  # future -> launch time (hang-hedge input)
         hedged: set = set()  # futures already replaced for being slow
+        next_i = 0
 
-        def launch(idx: int):
-            f = self._read_pool.submit(
-                self._read_shard, vol, idx, blob.bid, 0, shard_len)
+        def launch() -> None:
+            nonlocal next_i
+            if next_i >= len(candidates):
+                return
+            idx = candidates[next_i]
+            next_i += 1
+            f = self._read_pool.submit(self._read_shard, vol, idx, bid, lo, n)
             pending[f] = idx
             launched[f] = time.monotonic()
 
-        for idx in order[:t.read_hedge]:
-            launch(idx)
-        next_i = t.read_hedge
+        for _ in range(min(needed, len(candidates))):
+            launch()
         # overall gather budget: stragglers can be slow-but-alive, so this
         # is the generous write_deadline, not the per-read read_deadline
-        gather_deadline = now + self.write_deadline
-        while pending and len(present) < t.N:
+        gather_deadline = time.monotonic() + self.write_deadline
+        while pending and len(got) < needed:
             # wake for the earliest of: gather budget, or the moment an
             # un-hedged in-flight read crosses read_deadline
             now = time.monotonic()
@@ -977,40 +1023,143 @@ class Access:
                     break  # budget exhausted: abandon what never answered
                 # an in-flight read exceeded read_deadline without FAILING —
                 # a hung-but-silent replica. Launch a replacement from the
-                # not-yet-tried shards (the original keeps running: slow-but-
-                # alive may still answer first), so hedge depth holds against
-                # hangs exactly as against failures.
+                # not-yet-tried candidates (the original keeps running), so
+                # gather depth holds against hangs exactly as against
+                # failures.
                 for f in list(pending):
                     if (f in hedged
                             or now - launched[f] < self.read_deadline):
                         continue
                     hedged.add(f)
-                    if next_i < total:
-                        launch(order[next_i])
-                        next_i += 1
+                    launch()
                 continue
             for fut in done:
                 idx = pending.pop(fut)
                 launched.pop(fut, None)
-                was_hedged = fut in hedged  # its replacement already launched
+                was_hedged = fut in hedged  # replacement already launched
                 hedged.discard(fut)
                 data = fut.result()
                 if data is not None:
-                    stripe[idx] = np.frombuffer(data, np.uint8)
-                    present.append(idx)
+                    got[idx] = data
                 else:
-                    failed.append(idx)
-                    if not was_hedged and next_i < total:
-                        launch(order[next_i])  # keep hedge depth
-                        next_i += 1
+                    failures.append(idx)
+                    if not was_hedged:
+                        launch()  # keep gather depth
         for fut in pending:  # abandon stragglers (queued ones cancel cleanly)
             fut.cancel()
+        return got, failures
+
+    def _degraded_window(self, t, vol, blob, shard_len, offset, size,
+                         have: dict[int, bytes], slow: set[int],
+                         failed_direct: set[int]) -> bytes | None:
+        """Range-scoped degraded read: decode ONLY the in-window shards the
+        direct phase is missing, over only the window's byte columns. RS is
+        column-independent, so t.N survivor rows sliced to the SAME columns
+        decode the missing rows' slice exactly (RSKernel.window_matrix).
+        Returns None when the gather can't reach N global survivors — deep
+        damage, which the full-stripe path (with AZ-local recovery) owns."""
+        from chubaofs_tpu.blobstore import trace
+
+        span = trace.current_span()
+        t_gather = time.perf_counter()
+        first = offset // shard_len
+        last = (offset + size - 1) // shard_len
+
+        def window_of(idx: int) -> tuple[int, int]:
+            lo = max(offset, idx * shard_len) - idx * shard_len
+            hi = min(offset + size, (idx + 1) * shard_len) - idx * shard_len
+            return lo, hi
+
+        need = [i for i in range(first, last + 1) if i not in have]
+        # the union byte-column window the decode must cover
+        col_lo = min(window_of(i)[0] for i in need)
+        col_hi = max(window_of(i)[1] for i in need)
+        width = col_hi - col_lo
+        # survivors the direct phase already fetched, column-sliced — only
+        # reads fully covering the decode window count (edge shards of the
+        # byte range may cover less; those shards just aren't reused)
+        reuse: dict[int, bytes] = {}
+        for i, data in have.items():
+            lo_i, hi_i = window_of(i)
+            if lo_i <= col_lo and hi_i >= col_hi:
+                reuse[i] = data[col_lo - lo_i: col_hi - lo_i]
+        # candidates in preference order: untouched data shards first, then
+        # parity; shards that just FAILED are excluded, known-slow go last.
+        # The gather fetches exactly the survivors it selects — unselected
+        # parity is never read (no speculative parity fan-out).
+        candidates = [i for i in range(t.N + t.M)
+                      if i not in reuse and i not in failed_direct
+                      and i not in need]
+        candidates.sort(key=lambda i: (i in slow, i))
+        got, gather_failed = self._gather_survivors(
+            vol, blob.bid, candidates, t.N - len(reuse), col_lo, width)
+        got.update(reuse)
+        if span is not None:
+            span.add_stage("gather", start=t_gather)  # windowed sub-reads
+        if len(got) < t.N:
+            return None  # the full path re-proves and reports damage
+        present = sorted(got)[: t.N]
+        survivors = np.stack(
+            [np.frombuffer(got[i], np.uint8) for i in present])
+        t_dec = time.perf_counter()
+        rows = self.codec.decode_rows(t.N, t.M, present, survivors,
+                                      need).result()
+        registry("access").counter(
+            "read_bytes", {"kind": "decoded"}).add(len(need) * width)
+        if span is not None:
+            span.add_stage("decode", start=t_dec)  # row-sliced window decode
+        # assemble: verbatim direct-phase bytes, decoded rows sliced to each
+        # missing shard's own sub-window
+        rowpos = {i: p for p, i in enumerate(need)}
+        out = bytearray()
+        for i in range(first, last + 1):
+            if i in have:
+                out += have[i]
+            else:
+                lo_i, hi_i = window_of(i)
+                out += rows[rowpos[i],
+                            lo_i - col_lo: hi_i - col_lo].tobytes()
+        # the repair plane must hear what this read PROVED damaged; shards
+        # it never touched are probed asynchronously (off the latency path)
+        # so ranged reads don't narrow get_miss-driven healing
+        damaged = sorted(failed_direct | set(gather_failed))
+        self.proxy.send_shard_repair(vol.vid, blob.bid, damaged, "get_miss")
+        touched = set(got) | set(have) | set(damaged)
+        self._probe_unread(t, vol, blob, shard_len,
+                           [i for i in range(t.N + t.M) if i not in touched])
+        return bytes(out)
+
+    def _degraded_full(self, t, vol, blob, shard_len, offset, size,
+                       slow: set[int]) -> bytes:
+        """Full-stripe degraded gather (stream_get.go:427 ReconstructData
+        fallback) — the deep-damage path: whole shards are read because
+        AZ-local stripes repair whole shards. The gather still launches only
+        the t.N survivors it selects (failure replacement + hang-hedge per
+        read); parity beyond the selection stays unread. When the global
+        stripe alone can't reach N and the mode carries local parities,
+        AZ-local stripes are tried next (work_shard_recover.go:517
+        recoverByLocalStripe applied at READ time)."""
+        from chubaofs_tpu.blobstore import trace
+
+        span = trace.current_span()
+        t_gather = time.perf_counter()
+        total = t.N + t.M
+        # data shards first (they skip the matmul); known-wedged ones last
+        order = sorted(range(total), key=lambda i: (i in slow, i))
+        gather_deadline = time.monotonic() + self.write_deadline
+        got, failed = self._gather_survivors(vol, blob.bid, order, t.N,
+                                             0, shard_len)
+        stripe = np.zeros((total, shard_len), np.uint8)
+        present: list[int] = []
+        for i, data in got.items():
+            stripe[i] = np.frombuffer(data, np.uint8)
+            present.append(i)
         if span is not None:
             span.add_stage("gather", start=t_gather)  # hedged stripe reads
         # the repair plane must hear about everything the gather PROVED
         # damaged — including shards the local-stripe pass then fixes only
         # in memory (they are still broken on disk). Shards the hedge never
-        # reached are probed ASYNCHRONOUSLY (off the latency path) below, so
+        # reached are probed ASYNCHRONOUSLY (off the latency path), so
         # hedging does not narrow get_miss-driven healing vs a full gather.
         damaged = sorted(failed)
         if len(present) < t.N and getattr(t, "L", 0):
@@ -1023,26 +1172,34 @@ class Access:
             )
         t_dec = time.perf_counter()
         fixed = self.codec.reconstruct(t.N, t.M, stripe, missing, data_only=True).result()
+        registry("access").counter("read_bytes", {"kind": "decoded"}).add(
+            sum(shard_len for i in missing if i < t.N))
         if span is not None:
             span.add_stage("decode", start=t_dec)  # on-the-fly reconstruct
         self.proxy.send_shard_repair(vol.vid, blob.bid, damaged, "get_miss")
-        unprobed = [i for i in range(total)
-                    if i not in present and i not in failed]
-        if unprobed:
-            # probes ride their OWN executor (never the PUT/write pool: a
-            # wedged blobnode would pin write workers and stall unrelated
-            # stripe writes) and dedupe per (vid, bid): a burst of degraded
-            # GETs of one hot blob probes it once
-            key = (vol.vid, blob.bid)
-            with self._probe_lock:
-                fresh = key not in self._probing
-                if fresh:
-                    self._probing.add(key)
-            if fresh:
-                self._probe_pool.submit(self._probe_shards, t, vol, blob,
-                                        shard_len, unprobed)
+        self._probe_unread(t, vol, blob, shard_len,
+                           [i for i in range(total)
+                            if i not in present and i not in failed])
         data_region = fixed[: t.N].reshape(-1)
         return data_region[offset : offset + size].tobytes()
+
+    def _probe_unread(self, t, vol, blob, shard_len,
+                      unprobed: list[int]) -> None:
+        """Launch the async integrity probe for shards a degraded read never
+        touched. Probes ride their OWN executor (never the PUT/write pool: a
+        wedged blobnode would pin write workers and stall unrelated stripe
+        writes) and dedupe per (vid, bid): a burst of degraded GETs of one
+        hot blob probes it once."""
+        if not unprobed:
+            return
+        key = (vol.vid, blob.bid)
+        with self._probe_lock:
+            fresh = key not in self._probing
+            if fresh:
+                self._probing.add(key)
+        if fresh:
+            self._probe_pool.submit(self._probe_shards, t, vol, blob,
+                                    shard_len, unprobed)
 
     def _probe_shards(self, t, vol, blob, shard_len, idxs: list[int]) -> None:
         """Background integrity probe of shards a hedged gather skipped or
@@ -1052,7 +1209,7 @@ class Access:
         read_deadline — a wedged node makes the probe REPORT, not hang."""
         try:
             futs = {self._probe_io.submit(
-                self._read_shard, vol, i, blob.bid, 0, shard_len): i
+                self._read_shard, vol, i, blob.bid, 0, shard_len, False): i
                 for i in idxs}
             bad = []
             for fut, i in futs.items():

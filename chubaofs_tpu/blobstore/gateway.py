@@ -19,6 +19,30 @@ from chubaofs_tpu.rpc.router import Request, Response, Router
 from chubaofs_tpu.rpc.server import RPCServer
 
 
+def parse_http_range(rng: str, size: int) -> tuple[int, int] | None:
+    """`bytes=lo-hi` / `bytes=lo-` / `bytes=-N` -> (offset, length), clipped
+    to the object. None means syntactically valid but unsatisfiable (RFC
+    9110: the caller answers 416); malformed raises ValueError (400)."""
+    if not rng.startswith("bytes="):
+        raise ValueError(f"unsupported range unit: {rng}")
+    lo_s, dash, hi_s = rng[len("bytes="):].partition("-")
+    if not dash or (not lo_s and not hi_s):
+        raise ValueError(f"malformed range: {rng}")
+    if lo_s == "":  # suffix form bytes=-N: the last N bytes
+        length = int(hi_s)
+        if length <= 0:
+            return None
+        lo = max(0, size - length)
+        hi = size - 1
+    else:
+        lo = int(lo_s)
+        hi = int(hi_s) if hi_s else size - 1
+    if lo >= size or lo > hi:
+        return None
+    hi = min(hi, size - 1)
+    return lo, hi - lo + 1
+
+
 def build_router(access: Access) -> Router:
     r = Router()
 
@@ -32,6 +56,34 @@ def build_router(access: Access) -> Router:
 
     def get(req: Request):
         loc = req.q("location")
+        rng = req.header("range")
+        if rng:
+            # HTTP Range surface (the S3-shaped path): 206 + Content-Range,
+            # 416 on an unsatisfiable window — the ranged read underneath is
+            # the byte-window shard gather, so the wire AND the backend both
+            # move window bytes only
+            try:
+                obj_size = Location.from_json(loc).size
+            except Exception:
+                raise HTTPError(400, msg="bad location token",
+                                code="LocationError") from None
+            try:
+                parsed = parse_http_range(rng, obj_size)
+            except ValueError as e:
+                raise HTTPError(400, msg=str(e), code="InvalidRange") from None
+            if parsed is None:
+                return Response(416, {"Content-Range": f"bytes */{obj_size}"})
+            offset, size = parsed
+            try:
+                data = access.get(loc, offset, size)
+            except AccessError as e:
+                raise HTTPError(404, msg=str(e), code="AccessError") from None
+            return Response(
+                206,
+                {"Content-Type": "application/octet-stream",
+                 "Content-Range":
+                     f"bytes {offset}-{offset + size - 1}/{obj_size}"},
+                data)
         offset = int(req.q("offset", "0"))
         size = int(req.q("size", "-1"))
         try:
@@ -109,6 +161,18 @@ class AccessClient:
         if status != 200:
             raise AccessError(body.decode() or f"get failed: {status}")
         return body
+
+    def get_range(self, loc: Location | str,
+                  rng: str) -> tuple[int, dict, bytes]:
+        """HTTP `Range:` GET — returns the raw (status, headers, body) so
+        the caller sees 206/416 and Content-Range, the contract an S3-style
+        frontend proxies through verbatim."""
+        import urllib.parse
+
+        token = loc.to_json() if isinstance(loc, Location) else loc
+        return self.rpc.do(
+            "GET", f"/get?location={urllib.parse.quote(token, safe='')}",
+            headers={"Range": rng})
 
     def delete(self, loc: Location | str) -> None:
         token = loc.to_json() if isinstance(loc, Location) else loc

@@ -208,6 +208,32 @@ class CodecService:
         job.future.add_done_callback(_finish)
         return out_future
 
+    def decode_rows(self, n: int, m: int, present: list[int],
+                    survivors: np.ndarray, want: list[int]) -> Future:
+        """Range-scoped degraded decode: survivors (n, w) uint8 — the chosen
+        n survivor shards' bytes over just the window's byte columns, row
+        order matching `present` — -> Future[(len(want), w) uint8] holding
+        ONLY the wanted shard rows over those columns.
+
+        Never materializes the full stripe: the decode matrix is sliced to
+        the wanted rows on the host (RSKernel.window_matrix), so the device
+        pass is (len(want), n) @ (n, w) — window-sized both ways. Jobs with
+        the identical (present, want) pattern batch on the device exactly
+        like repairs (content-keyed matrix signature).
+        """
+        kernel = rs.get_kernel(n, m)
+        mat = kernel.window_matrix(present, want)
+        survivors = np.asarray(survivors, np.uint8)
+        if survivors.ndim != 2 or survivors.shape[0] != n:
+            raise ValueError(
+                f"want ({n}, w) survivors, got {survivors.shape}")
+        k = survivors.shape[1]
+        kb = bucket_len(k)
+        job = _Job("matmul", n, m, _pad_to_bucket(survivors, k, kb),
+                   k, kb, mat=mat)
+        self._submit(job)
+        return job.future
+
     def close(self):
         """Idempotent shutdown; jobs enqueued after close() fail fast, jobs
         still queued when the sentinel lands get an exception (never a hang)."""

@@ -81,6 +81,21 @@ def _text(el, tag: str, default: str = "") -> str:
     return child.text or default if child is not None else default
 
 
+def _etag_matches(header: str, etag: str) -> bool:
+    """RFC 9110 If-(None-)Match list: `*`, or any listed etag equal to the
+    object's — quoted or bare, weak prefixes tolerated (crc etags here are
+    always strong, so W/ comparison degenerates to equality)."""
+    for v in header.split(","):
+        v = v.strip()
+        if v == "*":
+            return True
+        if v.startswith("W/"):
+            v = v[2:]
+        if v.strip('"') == etag:
+            return True
+    return False
+
+
 # sub-resources the reference routes to unsupportedOperationHandler
 # (router.go; v3.2.1 also lists lifecycle/versioning/versions there, which
 # THIS gateway implements)
@@ -516,6 +531,15 @@ class ObjectNode:
         headers = self._object_headers(info)
         if vid:
             headers["x-amz-version-id"] = vid
+        # conditional GET (RFC 9110 §13): the validator is the etag the crc
+        # ledger already stamped on the object — If-Match guards a stale
+        # reader (412), If-None-Match serves revalidations headers-only (304)
+        im = req.header("if-match")
+        if im and not _etag_matches(im, info["etag"]):
+            raise S3Error(412, "PreconditionFailed", "If-Match")
+        inm = req.header("if-none-match")
+        if inm and _etag_matches(inm, info["etag"]):
+            return Response(304, headers)
         rng = req.header("range")
         if rng and rng.startswith("bytes="):
             try:

@@ -211,6 +211,32 @@ class RSKernel:
         mat = gf256.gf_matmul(self.gen[np.asarray(missing), :], dec) if missing else np.zeros((0, self.n), np.uint8)
         return mat, present, missing
 
+    def window_matrix(self, present: list[int], want: list[int]) -> np.ndarray:
+        """Row-sliced decode matrix for ranged reads: the GF(2^8) map from
+        exactly n survivor rows (in `present` order) to exactly the `want`
+        shard rows — gen[want] @ inv(gen[present]).
+
+        Unlike repair_matrix this takes the caller's survivor CHOICE as-is
+        (the access layer's windowed gather already picked which shards to
+        fetch) and computes only the rows the byte window needs, so degraded
+        decode cost scales with the window, not the stripe. RS is column-
+        independent, so the same matrix applied to column-sliced survivors
+        yields the identical column slice of the wanted shards.
+        """
+        present = [int(i) for i in present]
+        want = [int(i) for i in want]
+        if len(present) != self.n:
+            raise ValueError(
+                f"window decode needs exactly n={self.n} survivors, "
+                f"got {len(present)}")
+        for i in present + want:
+            if not 0 <= i < self.total:
+                raise ValueError(f"bad shard index {i}")
+        if not want:
+            return np.zeros((0, self.n), np.uint8)
+        dec = gf256.decode_matrix(self.gen, present)  # (n, n)
+        return gf256.gf_matmul(self.gen[np.asarray(want), :], dec)
+
     def repair_plan(self, bad_idx: list[int], data_only: bool = False):
         """Device-ready repair plan: (repair_bits, present, missing) numpy arrays.
 

@@ -93,6 +93,43 @@ def is_repair_metric(name: str) -> bool:
     return any(mark in name for mark in REPAIR_METRIC_MARKS)
 
 
+READ_METRIC_MARKS = ("cfs_access_read_bytes", "cfs_access_get",
+                     "cfs_access_read_fail", "cfs_cache_", "cfs_bcache_",
+                     "shard_get")
+
+
+def is_read_metric(name: str) -> bool:
+    """The read-path rollup filter (--reads, ISSUE 17): the read-amp byte
+    ledger (requested/shards_read/decoded), GET latency/error families,
+    cache-plane and block-store counters, and blobnode shard-get traffic."""
+    return any(mark in name for mark in READ_METRIC_MARKS)
+
+
+def read_amp_summary(before: dict[str, float],
+                     after: dict[str, float]) -> dict | None:
+    """Window read-amp rollup from two snapshots: shards_read/requested
+    (and the decoded share), restart-clamped per series. None when the
+    window served no reads — callers print nothing rather than 0.0."""
+    def kind_delta(kind: str) -> float:
+        tot = 0.0
+        for key, a in after.items():
+            if (not key.startswith("cfs_access_read_bytes")
+                    or f'kind="{kind}"' not in key):
+                continue
+            d = a - before.get(key, 0.0)
+            tot += a if d < 0 else d
+        return tot
+
+    req = kind_delta("requested")
+    if req <= 0:
+        return None
+    shards = kind_delta("shards_read")
+    decoded = kind_delta("decoded")
+    return {"requested_bytes": req, "shards_read_bytes": shards,
+            "decoded_bytes": decoded,
+            "read_amp": round(shards / req, 3)}
+
+
 def scrape(addr: str, path: str = "/metrics", timeout: float = 10.0) -> str:
     from chubaofs_tpu.rpc.pool import NullPool
 
@@ -128,6 +165,10 @@ def main(argv=None, out=None) -> int:
                         "metrics (task counts by kind/state, lease "
                         "expiries, probe failures, scrub progress, repair "
                         "traffic), statics included")
+    p.add_argument("--reads", action="store_true",
+                   help="read-path rollup: read-amp byte ledger, GET "
+                        "latency/errors, cache plane, blobnode shard-get "
+                        "traffic — plus a computed read_amp summary line")
     p.add_argument("--all", action="store_true",
                    help="include zero-delta metrics")
     p.add_argument("--slowops", action="store_true",
@@ -173,10 +214,17 @@ def main(argv=None, out=None) -> int:
         # a repair inventory is mostly GAUGES sitting still (tasks by
         # kind/state): statics are the point, so --repair implies --all
         rows = [r for r in rows if is_repair_metric(r["metric"])]
+    elif args.reads:
+        rows = [r for r in rows if is_read_metric(r["metric"])]
+        if not args.all:
+            rows = [r for r in rows if r["delta"] != 0]
     elif not args.all:
         rows = [r for r in rows if r["delta"] != 0]
+    amp = read_amp_summary(before, after) if args.reads else None
     if args.json:
         blob = {"interval_s": round(elapsed, 3), "rows": rows}
+        if amp is not None:
+            blob["read_amp"] = amp
         if args.slowops:
             blob["slowops"] = slowops
         print(json.dumps(blob, indent=2), file=out)
@@ -205,6 +253,11 @@ def main(argv=None, out=None) -> int:
                   file=out)
             if rec.get("track"):
                 print(f"    track: {rec['track']}", file=out)
+    if amp is not None:
+        print(f"\nread_amp: {amp['read_amp']:g}  "
+              f"(shards_read {amp['shards_read_bytes']:g}B / "
+              f"requested {amp['requested_bytes']:g}B; "
+              f"decoded {amp['decoded_bytes']:g}B)", file=out)
     return 0
 
 

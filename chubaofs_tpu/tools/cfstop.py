@@ -56,8 +56,8 @@ from chubaofs_tpu.utils.metrichist import (
 from chubaofs_tpu.utils.slo import FAILING, RANK
 
 COLUMNS = ("TARGET", "SLO", "UP", "PUT/S", "GET/S", "PUT99MS", "CONNS",
-           "BP/S", "LAG99", "CODEC/B", "CACHE%", "THR%", "META", "REPAIRQ",
-           "ALERTS")
+           "BP/S", "LAG99", "CODEC/B", "CACHE%", "RDAMP", "THR%", "META",
+           "REPAIRQ", "ALERTS")
 
 
 # -- scraping ------------------------------------------------------------------
@@ -152,6 +152,22 @@ def _p99(prev: dict, cur: dict, family: str) -> float | None:
     return hist_quantile(buckets, count, 0.99)
 
 
+def _kind_delta(prev: dict, cur: dict, family: str, kind: str) -> float:
+    """Restart-clamped window delta of ONE kind-labeled series of a family —
+    family_sum would fold requested/shards_read/decoded together, and the
+    read-amp ratio needs them apart."""
+    tot = 0.0
+    for k, v in cur.items():
+        name, labels = parse_key(k)
+        if name != family or labels.get("kind") != kind:
+            continue
+        d = v - prev.get(k, 0.0)
+        if d < 0:
+            d = v  # counter restarted: the post-restart total is the window
+        tot += d
+    return tot
+
+
 def _hottest_pid_rate(prev: dict, cur: dict, dt: float) -> float:
     """Max per-partition window rate of cfs_metanode_partition_ops{pid} —
     per-SERIES deltas (not family_sum: the hottest partition is the split
@@ -228,6 +244,13 @@ def compute_row(target: str, prev: dict | None, cur: dict | None,
     lookups = _rate(prev, cur, "cfs_cache_lookups", 1.0)
     hits = _rate(prev, cur, "cfs_cache_hits", 1.0)
     row["cache_pct"] = round(100.0 * hits / lookups, 1) if lookups > 0 else None
+    # read amplification over the window (ISSUE 17): backend shard bytes
+    # fetched per byte the callers asked for — ~1.0 means ranged reads move
+    # window bytes only, stripe/range means whole-stripe gathers; '-' on
+    # targets that served no reads this window
+    req_b = _kind_delta(prev, cur, "cfs_access_read_bytes", "requested")
+    shard_b = _kind_delta(prev, cur, "cfs_access_read_bytes", "shards_read")
+    row["read_amp"] = round(shard_b / req_b, 2) if req_b > 0 else None
     # QoS throttled-request share over the window (ISSUE 14): what fraction
     # of this gateway's requests the per-tenant plane turned away; '-' on
     # targets that saw no shaped requests (plane unarmed, or not a gateway)
@@ -282,6 +305,7 @@ def render(rows: list[dict], errors: list[str] = ()) -> str:
               _cell(r.get("put99_ms")), _cell(r.get("conns")),
               _cell(r.get("bp_s")), _cell(r.get("lag99_ms")),
               _cell(r.get("codec_occ")), _cell(r.get("cache_pct")),
+              _cell(r.get("read_amp")),
               _cell(r.get("thr_pct")), _meta_cell(r),
               _cell(r.get("repair_q")), _cell(r.get("alerts"))]
              for r in rows]

@@ -1385,6 +1385,128 @@ def bench_cache_zipf(root: str, objects: int = 32, obj_kb: int = 64,
     return out
 
 
+def bench_ranged(root: str, blob_mb: int = 4,
+                 range_kbs: tuple = (4, 64, 256, 1024),
+                 gets_per: int = 4, cache_mb: int = 16,
+                 seed: int = 11) -> dict:
+    """Partial-stripe ranged reads (ISSUE 17): bytes-read scales with the
+    RANGE, not the blob.
+
+    One blob_mb blob (4 MiB -> a single EC12P4 stripe under the 1-AZ
+    policy) served three ways per range size, with the
+    cfs_access_read_bytes{kind} counter deltas turned into per-arm ratios:
+
+      * healthy/uncached — in-window sub-shard reads only; the floor is
+        shards_read/stripe_bytes < 1/4 for any <=256 KiB range
+        (acceptance: the old path gathered the whole stripe every time);
+      * degraded — one in-window data shard lost: range-scoped survivor
+        gather + row-sliced decode, so shards_read is N x window, never
+        N x shard, and decoded bytes are window-sized;
+      * cached — block-granular BlobCache: the repeat pass must be all
+        hits with ZERO backend shard bytes.
+
+    Every ranged GET (healthy AND degraded) is byte-compared against the
+    whole-object slice — a miscompare raises, the same correctness-first
+    contract as bench_cache_zipf's crc gate. Tier-1 floors ride
+    tests/test_perfbench.py at smoke size."""
+    import random
+
+    from chubaofs_tpu.blobstore.cache import BlobCache
+    from chubaofs_tpu.blobstore.cluster import MiniCluster
+    from chubaofs_tpu.codec.codemode import get_tactic
+    from chubaofs_tpu.utils import exporter
+
+    rng = random.Random(seed)
+    reg = exporter.registry("access")
+
+    def ctr(kind: str) -> float:
+        return reg.counter("read_bytes", {"kind": kind}).value
+
+    data = os.urandom(blob_mb << 20)
+    out: dict = {}
+    # EC12P4 needs 16 units; 9 nodes x 2 disks covers it. cache=None must
+    # really mean cache-less (MiniCluster falls back to from_env otherwise)
+    prev_mb = os.environ.pop("CFS_CACHE_MB", None)
+    try:
+        c = MiniCluster(os.path.join(root, "mc"), n_nodes=9,
+                        disks_per_node=2, cache=None)
+        try:
+            loc = c.access.put(data)
+            c.access.get(loc, 0, 4096)  # jit/warm outside the counters
+            blob = loc.blobs[0]
+            t = get_tactic(loc.code_mode)
+            shard_len = t.shard_size(blob.size)
+            stripe_bytes = t.N * shard_len  # the old whole-gather cost
+            out["ranged_stripe_bytes"] = stripe_bytes
+            for rkb in range_kbs:
+                rlen = min(rkb * 1024, len(data))
+                offs = [rng.randrange(0, len(data) - rlen + 1)
+                        for _ in range(gets_per)]
+                s0, q0 = ctr("shards_read"), ctr("requested")
+                for off in offs:
+                    if c.access.get(loc, off, rlen) != data[off:off + rlen]:
+                        raise AssertionError(
+                            f"healthy ranged miscompare at {off}+{rlen}")
+                req = ctr("requested") - q0
+                out[f"ranged_amp_{rkb}k"] = round(
+                    (ctr("shards_read") - s0) / req, 3) if req else 0.0
+                out[f"ranged_stripe_frac_{rkb}k"] = round(
+                    (ctr("shards_read") - s0) / gets_per / stripe_bytes, 4)
+            # degraded arm: lose a data shard, read windows INSIDE it so
+            # every GET exercises the range-scoped decode
+            vol = c.cm.get_volume(blob.vid)
+            unit = vol.units[1]
+            c.nodes[unit.node_id].lose_shard(unit.vuid, blob.bid)
+            rlen = min(range_kbs[0] * 1024, shard_len // 2)
+            offs = [shard_len + rng.randrange(0, shard_len - rlen)
+                    for _ in range(gets_per)]
+            s0, q0, d0 = (ctr("shards_read"), ctr("requested"),
+                          ctr("decoded"))
+            for off in offs:
+                if c.access.get(loc, off, rlen) != data[off:off + rlen]:
+                    raise AssertionError(
+                        f"degraded ranged miscompare at {off}+{rlen}")
+            req = ctr("requested") - q0
+            out["ranged_amp_degraded"] = round(
+                (ctr("shards_read") - s0) / req, 3) if req else 0.0
+            out["ranged_decoded_frac_degraded"] = round(
+                (ctr("decoded") - d0) / gets_per / stripe_bytes, 4)
+        finally:
+            c.close()
+        # cached arm: block-granular fills — a repeat of the same ranges
+        # is all hits, zero backend shard bytes
+        cache = BlobCache(os.path.join(root, "cachedir"), mem_mb=cache_mb)
+        c2 = MiniCluster(os.path.join(root, "mc2"), n_nodes=9,
+                         disks_per_node=2, cache=cache)
+        try:
+            loc = c2.access.put(data)
+            rlen = min(64 * 1024, len(data))
+            offs = [rng.randrange(0, len(data) - rlen + 1)
+                    for _ in range(gets_per)]
+            for off in offs:  # fill pass
+                if c2.access.get(loc, off, rlen) != data[off:off + rlen]:
+                    raise AssertionError("cached fill-pass miscompare")
+            creg = exporter.registry("cache")
+            h0 = creg.counter("hits").value
+            s0 = ctr("shards_read")
+            for off in offs:  # repeat pass
+                if c2.access.get(loc, off, rlen) != data[off:off + rlen]:
+                    raise AssertionError("cached hit-pass miscompare")
+            out["ranged_cached_hits"] = int(creg.counter("hits").value - h0)
+            out["ranged_cached_backend_bytes"] = int(ctr("shards_read") - s0)
+        finally:
+            c2.close()
+    finally:
+        if prev_mb is not None:
+            os.environ["CFS_CACHE_MB"] = prev_mb
+    frac_keys = [k for k in out if k.startswith("ranged_stripe_frac_")]
+    log(f"  ranged: stripe_frac per range "
+        f"{ {k.split('_')[-1]: out[k] for k in frac_keys} } "
+        f"degraded_amp={out['ranged_amp_degraded']} "
+        f"cached_backend_bytes={out['ranged_cached_backend_bytes']}")
+    return out
+
+
 def bench_events(root: str, n_events: int = 10_000, puts: int = 6,
                  blob_kb: int = 64) -> dict:
     """Events-overhead smoke (ISSUE 13): the plane's two cost contracts.
@@ -1493,6 +1615,15 @@ def run(root: str, n_files: int = 600, n_clients: int = 4,
     else:  # smoke invocations get a smoke-size zipf sweep
         cfg.update(bench_cache_zipf(os.path.join(root, "cachebench"),
                                     objects=12, obj_kb=32, gets=80))
+    # ranged-read A/B rides the same post-ProcCluster slot (floor-deflation
+    # lesson): its MiniClusters + 4 MiB puts would throttle-deflate the
+    # md/stream floors if it ran ahead of them
+    log("ranged reads (byte-window gather, healthy/degraded/cached)...")
+    if n_files >= 300:
+        cfg.update(bench_ranged(os.path.join(root, "rangedbench")))
+    else:  # smoke invocations get a smoke-size range sweep
+        cfg.update(bench_ranged(os.path.join(root, "rangedbench"),
+                                blob_mb=2, range_kbs=(16, 256), gets_per=2))
     # the gateway phases run AFTER the ProcCluster phases for the same
     # reason as bench_concurrency/bench_cache_zipf (the PR-8/PR-12 floor-
     # deflation lesson): the 1024-conn sweep saturates every core, and a

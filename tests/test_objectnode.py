@@ -158,6 +158,51 @@ def test_range_get(s3):
     assert status == 416
 
 
+def test_conditional_get_if_none_match(s3):
+    req(s3, "PUT", "/condbkt")
+    data = b"conditional body " * 100
+    _, headers, _ = req(s3, "PUT", "/condbkt/obj", body=data)
+    etag = headers["ETag"]
+    # matching If-None-Match: 304, no body, cacheable headers still present
+    status, headers, body = req(s3, "GET", "/condbkt/obj",
+                                headers={"if-none-match": etag})
+    assert status == 304 and body == b""
+    assert headers["ETag"] == etag
+    # bare (unquoted), weak, and wildcard forms all match
+    for form in (etag.strip('"'), f"W/{etag}", "*",
+                 f'"deadbeef", {etag}'):
+        status, _, body = req(s3, "GET", "/condbkt/obj",
+                              headers={"if-none-match": form})
+        assert status == 304 and body == b"", form
+    # mismatch: normal 200
+    status, _, body = req(s3, "GET", "/condbkt/obj",
+                          headers={"if-none-match": '"deadbeef"'})
+    assert status == 200 and body == data
+
+
+def test_conditional_get_if_match(s3):
+    req(s3, "PUT", "/condbkt2")
+    data = b"if-match body"
+    _, headers, _ = req(s3, "PUT", "/condbkt2/obj", body=data)
+    etag = headers["ETag"]
+    for form in (etag, "*"):
+        status, _, body = req(s3, "GET", "/condbkt2/obj",
+                              headers={"if-match": form})
+        assert status == 200 and body == data, form
+    status, _, body = req(s3, "GET", "/condbkt2/obj",
+                          headers={"if-match": '"deadbeef"'})
+    assert status == 412 and b"PreconditionFailed" in body
+    # conditional + Range compose: fresh etag ranges normally
+    status, _, body = req(s3, "GET", "/condbkt2/obj",
+                          headers={"if-match": etag, "range": "bytes=0-4"})
+    assert status == 206 and body == data[:5]
+    # If-None-Match wins over Range on a match (304 beats 206)
+    status, _, body = req(s3, "GET", "/condbkt2/obj",
+                          headers={"if-none-match": etag,
+                                   "range": "bytes=0-4"})
+    assert status == 304 and body == b""
+
+
 def test_copy_object(s3):
     req(s3, "PUT", "/srcb")
     req(s3, "PUT", "/dstb")

@@ -192,3 +192,22 @@ def test_meta_scale_bench_smoke_floor(tmp_path):
         assert out[f"meta_create_ops_{parts}p"] > 0, out
     assert out["meta_leader_nodes"] >= 2, out
     assert out["meta_scale_speedup"] > 0, out
+
+
+def test_ranged_bench_smoke_floor(tmp_path):
+    """Tier-1 ranged-read gate (ISSUE 17): a sub-shard range on an EC12P4
+    blob must move fewer backend bytes than the data stripe (the byte-window
+    gather claim — floored at <1/4 stripe for a 64 KiB window on a 2 MiB
+    blob, against ~1/12 expected), with amp ~1 (window bytes only), the
+    degraded arm byte-identical (the phase raises on any mismatch), and the
+    cached repeat pass serving from block keys with ZERO backend bytes.
+    Latency floors stay in PERF.md, not CI (co-tenant noise policy)."""
+    from chubaofs_tpu.tools.perfbench import bench_ranged
+
+    out = bench_ranged(str(tmp_path), blob_mb=2, range_kbs=(64,), gets_per=2)
+    assert out["ranged_stripe_frac_64k"] < 0.25, out
+    assert 0 < out["ranged_amp_64k"] < 2.0, out
+    assert out["ranged_amp_degraded"] > 0, out
+    assert out["ranged_decoded_frac_degraded"] < 0.25, out
+    assert out["ranged_cached_hits"] > 0, out
+    assert out["ranged_cached_backend_bytes"] == 0, out
