@@ -33,7 +33,40 @@ SIZES = [8_000, 120_000, 700_000, 2_000_000]
 
 
 class SoakFailure(AssertionError):
-    pass
+    """A soak gate tripped. When the flight recorder captured an incident
+    bundle for it, `bundle` carries the directory path (cfs-chaos-soak
+    prints it in the failure report)."""
+
+    bundle: str | None = None
+
+
+def _capture_on_failure(fn):
+    """Freeze an incident bundle the moment a soak gate trips — the rings
+    the postmortem needs (events, slowops, metric history, traces) are
+    in-process and still warm right here; by the time an operator reruns
+    anything they've rotated. Explicit capture works even with CFS_FLIGHT
+    unset (the on-demand contract); a capture error must never mask the
+    soak failure itself."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except SoakFailure as e:
+            try:
+                from chubaofs_tpu.utils import flightrec
+
+                man = flightrec.capture(trigger="soak_failure",
+                                        fingerprint=f"soak:{fn.__name__}",
+                                        alert={"name": fn.__name__,
+                                               "error": str(e)})
+                e.bundle = man.get("bundle")
+            except Exception:
+                pass
+            raise
+
+    return wrapped
 
 
 class _AlertProbe:
@@ -120,6 +153,7 @@ def _assert_causal_order(evs: list[dict], seed: int) -> list[dict]:
     return chain
 
 
+@_capture_on_failure
 def run_soak(root: str, plan: FaultPlan | str, seed: int, rounds: int = 6,
              puts_per_round: int = 2, n_nodes: int = 9, disks_per_node: int = 2,
              sizes: list[int] | None = None, read_deadline: float = 0.5,
@@ -257,6 +291,7 @@ def run_soak(root: str, plan: FaultPlan | str, seed: int, rounds: int = 6,
         c.close()
 
 
+@_capture_on_failure
 def run_kill_soak(root: str, seed: int, n_nodes: int = 9,
                   disks_per_node: int = 2, warm_puts: int = 10,
                   live_puts: int = 8, hb_timeout: float = 0.75,
@@ -535,6 +570,7 @@ def run_kill_soak(root: str, seed: int, n_nodes: int = 9,
         c.close()
 
 
+@_capture_on_failure
 def run_meta_split_soak(root: str, seed: int, metanodes: int = 5,
                         dirs: int = 8, seed_files: int = 12,
                         creator_threads: int = 3, files_per_thread: int = 4000,
@@ -835,6 +871,7 @@ def run_meta_split_soak(root: str, seed: int, metanodes: int = 5,
         cluster.close()
 
 
+@_capture_on_failure
 def run_cache_soak(root: str, seed: int, rounds: int = 4, objects: int = 12,
                    obj_kb: int = 32, gets_per_round: int = 24,
                    invalidate_delay: float = 0.05, promote_hits: int = 4,

@@ -12,6 +12,7 @@ refreshed from /api/overview.
 from __future__ import annotations
 
 import json
+import os
 
 from chubaofs_tpu.master.api_service import MasterClient
 from chubaofs_tpu.rpc.errors import HTTPError
@@ -276,6 +277,48 @@ class Console:
             entries.sort(key=lambda e: e.get("ts", ""))
             return Response.json({"slowops": entries, "unreachable": missed})
 
+        def incident_rollup(req: Request):
+            """The incident collector (ISSUE 18): fan out to every known
+            daemon's /debug/bundle?collect=1 side-door and assemble ONE
+            cross-daemon incident directory keyed by the triggering alert
+            fingerprint, with the cause→evidence correlation cfs-doctor
+            renders. ?fingerprint=/&trigger= select the key; with neither,
+            the first firing alert in the cluster rollup is the cause.
+            Unreachable daemons are listed, never fatal."""
+            import urllib.parse
+
+            from chubaofs_tpu.tools import cfsdoctor
+            from chubaofs_tpu.utils import alerts as alertsmod
+            from chubaofs_tpu.utils import flightrec
+
+            fp = req.q("fingerprint") or ""
+            trigger = req.q("trigger") or "console"
+            alert = None
+            if not fp:
+                from chubaofs_tpu.tools.cfsevents import fetch_alerts
+
+                rollup = fetch_alerts(
+                    None, self.master_addrs + self.metrics_addrs,
+                    timeout=3.0)
+                for row in rollup.get("targets", []):
+                    for a in row.get("alerts", []):
+                        if a.get("state") == "firing":
+                            alert = a
+                            fp = alertsmod.fingerprint(
+                                a.get("name", ""), a.get("labels"))
+                            break
+                    if alert is not None:
+                        break
+            q = (f"/debug/bundle?collect=1"
+                 f"&trigger={urllib.parse.quote(trigger)}"
+                 f"&fingerprint={urllib.parse.quote(fp)}")
+            rows = _fanout(q)
+            out_root = os.path.join(flightrec.flight_dir(), "incidents")
+            incident = cfsdoctor.assemble_incident(
+                rows, out_root, fingerprint=fp, trigger=trigger,
+                alert=alert)
+            return Response.json(incident)
+
         r.get("/api/overview", overview)
         r.get("/api/metrics", metrics_rollup)
         r.get("/api/health", health_rollup)
@@ -283,6 +326,7 @@ class Console:
         r.get("/api/slowops", slowops_rollup)
         r.get("/api/events", events_rollup)
         r.get("/api/alerts", alerts_rollup)
+        r.get("/api/incident", incident_rollup)
         r.post("/graphql", graphql_proxy)
         return r
 

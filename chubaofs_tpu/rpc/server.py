@@ -246,6 +246,27 @@ class RPCServer:
 
             return Response.json(alerts.alerts_report())
 
+        def debug_bundle_route(r):
+            from chubaofs_tpu.utils import flightrec
+
+            if not flightrec.enabled():
+                return Response.json(
+                    {"error": "flight recorder disarmed (set CFS_FLIGHT=1) "
+                              "— alert-triggered and on-demand incident "
+                              "bundles are off"}, status=400)
+            rec = flightrec.default_recorder()
+            if r.q("collect"):
+                man = rec.capture(trigger=r.q("trigger") or "http",
+                                  fingerprint=r.q("fingerprint") or "")
+                # the sections ride INLINE so a console can assemble the
+                # cross-daemon incident dir centrally — each daemon keeps
+                # its own per-process bundle root
+                return Response.json(
+                    {"manifest": man,
+                     "payload": flightrec.bundle_payload(man["bundle"])})
+            return Response.json({"dir": rec.root,
+                                  "bundles": rec.list_bundles()})
+
         if metrics:
             router.get("/metrics", metrics_route)
             router.get("/traces", traces_route)
@@ -256,15 +277,17 @@ class RPCServer:
             router.get("/health", health_route)
             router.get("/events", events_route)
             router.get("/alerts", alerts_route)
+            router.get("/debug/bundle", debug_bundle_route)
             # env-armed sinks go live at daemon boot, not first scrape —
             # and stay the documented no-op when their env knob is unset
-            from chubaofs_tpu.utils import alerts, metrichist, profiler, \
-                tracesink
+            from chubaofs_tpu.utils import alerts, flightrec, metrichist, \
+                profiler, tracesink
 
             tracesink.activate_from_env()
             profiler.activate_from_env()
             metrichist.activate_from_env()
             alerts.activate_from_env()
+            flightrec.activate_from_env()
 
         outer = self
         self._inflight = 0

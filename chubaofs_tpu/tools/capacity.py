@@ -784,6 +784,10 @@ def run_capacity(args, rebalance: bool, root: str, out_path: str) -> dict:
         env[k] = v
     if args.failpoints:
         env["CFS_FAILPOINTS"] = args.failpoints
+    # the harness IS an incident consumer: arm every daemon's flight
+    # recorder so an SLO-gate flip can collect evidence (--daemon-env
+    # CFS_FLIGHT=0 opts out for the zero-overhead A/B)
+    env.setdefault("CFS_FLIGHT", "1")
     if getattr(args, "cache_mb", 0) > 0:
         # the cache-tier A/B lever: the blobstore daemon's MiniCluster
         # builds a BlobCache from this env knob, so the harness's zipfian
@@ -854,6 +858,20 @@ def run_capacity(args, rebalance: bool, root: str, out_path: str) -> dict:
             out["verdict"] = FAILING
             out["flipped"] = {**out.get("flipped", {}),
                               "workload": ["data-loss"]}
+        if out["verdict"] == FAILING:
+            # gate flipped: collect the cross-daemon incident bundle NOW,
+            # while the cluster (and its rings) is still alive — the
+            # failure report prints the path. Best-effort: a collection
+            # error must never mask the verdict itself.
+            try:
+                from chubaofs_tpu.tools.cfsstat import scrape
+
+                incident = json.loads(
+                    scrape(console, "/api/incident?trigger=capacity_gate",
+                           timeout=60.0))
+                out["incident_bundle"] = incident.get("dir")
+            except Exception:
+                out["incident_bundle"] = None
         return out
     finally:
         for th in (collector, spread):
@@ -954,8 +972,13 @@ def main(argv=None) -> int:
         alerts = result.get("alerts_fired") or {
             **result.get("off", {}).get("alerts_fired", {}),
             **result.get("on", {}).get("alerts_fired", {})}
+        bundle = (result.get("incident_bundle")
+                  or result.get("off", {}).get("incident_bundle")
+                  or result.get("on", {}).get("incident_bundle"))
         print(f"CAPACITY GATE FAILED: {json.dumps(flipped)}"
-              f" alerts={json.dumps(alerts)}",
+              f" alerts={json.dumps(alerts)}"
+              + (f" incident_bundle={bundle} (cfs-doctor inspect)"
+                 if bundle else ""),
               file=sys.stderr)
         return 1
     return 0

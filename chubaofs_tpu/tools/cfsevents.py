@@ -193,6 +193,55 @@ def correlate(events: list[dict], spans: list[dict],
     return items
 
 
+# -- offline bundle mode (ISSUE 18) --------------------------------------------
+#
+# Postmortems outlive clusters: --bundle points every view this CLI renders
+# at a collected flight-recorder bundle (one daemon's dir or a console-
+# assembled incident dir) instead of live side-doors.
+
+
+def bundle_events(bundle: dict, types: str = "",
+                  severity: str = "") -> list[dict]:
+    tset = {t for t in types.split(",") if t}
+    sset = {s for s in severity.split(",") if s}
+    evs = []
+    for payload in bundle["targets"].values():
+        for e in (payload.get("events") or {}).get("events", []):
+            if tset and e.get("type") not in tset:
+                continue
+            if sset and e.get("severity") not in sset:
+                continue
+            evs.append(e)
+    evs.sort(key=lambda e: e.get("ts", 0.0))
+    return evs
+
+
+def bundle_alerts(bundle: dict) -> dict:
+    """The frozen alert view in the merged-rollup shape render_alerts
+    expects: each target's triggering alert (bundles freeze the CAUSE, not
+    the whole /alerts table)."""
+    rows, firing = [], 0
+    for tname, payload in sorted(bundle["targets"].items()):
+        a = payload.get("alert") or {}
+        alist = [a] if a.get("name") else []
+        firing += sum(1 for x in alist if x.get("state") == "firing")
+        rows.append({"target": tname, "alerts": alist,
+                     "firing": sum(1 for x in alist
+                                   if x.get("state") == "firing")})
+    inc = bundle.get("incident") or {}
+    return {"targets": rows, "firing": firing,
+            "unreachable": inc.get("unreachable", [])}
+
+
+def bundle_spans(bundle: dict, trace_id: str) -> list[dict]:
+    spans: dict[str, dict] = {}
+    for payload in bundle["targets"].values():
+        for rec in (payload.get("traces") or {}).get("records", []):
+            if rec.get("trace_id") == trace_id and rec.get("span_id"):
+                spans.setdefault(rec["span_id"], rec)
+    return sorted(spans.values(), key=lambda r: r.get("start", 0.0))
+
+
 # -- CLI -----------------------------------------------------------------------
 
 
@@ -224,20 +273,41 @@ def main(argv=None, out=None) -> int:
                    help="show the merged alert view instead of the timeline")
     p.add_argument("--correlate", default="", metavar="TRACE_ID",
                    help="join events against this trace's spans")
+    p.add_argument("--bundle", default="",
+                   help="read from a collected flight-recorder bundle dir "
+                        "instead of live side-doors (postmortem mode)")
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
-    if not args.console and not args.addr:
-        p.error("give --console or --addr")
+    if not args.console and not args.addr and not args.bundle:
+        p.error("give --console, --addr, or --bundle")
+
+    bundle = None
+    if args.bundle:
+        if args.follow:
+            p.error("--follow needs a live cluster, not --bundle")
+        from chubaofs_tpu.tools.cfsdoctor import read_bundle
+
+        try:
+            bundle = read_bundle(args.bundle)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
 
     if args.alerts:
-        roll = fetch_alerts(args.console, args.addr)
+        roll = (bundle_alerts(bundle) if bundle is not None
+                else fetch_alerts(args.console, args.addr))
         print(json.dumps(roll, indent=2) if args.json
               else render_alerts(roll), file=out)
         return 0
 
-    events, cursor, missed = fetch_events(
-        args.console, args.addr, n=args.n, types=args.type,
-        severity=args.severity)
+    if bundle is not None:
+        events, cursor, missed = (
+            bundle_events(bundle, types=args.type, severity=args.severity),
+            0, (bundle.get("incident") or {}).get("unreachable", []))
+    else:
+        events, cursor, missed = fetch_events(
+            args.console, args.addr, n=args.n, types=args.type,
+            severity=args.severity)
     if args.since > 0:
         # event records carry WALL stamps (the cross-daemon merge key), so
         # the --since floor is wall arithmetic by protocol
@@ -245,7 +315,9 @@ def main(argv=None, out=None) -> int:
         events = [e for e in events if e.get("ts", 0.0) >= floor]
 
     if args.correlate:
-        spans = fetch_spans(args.console, args.addr, args.correlate)
+        spans = (bundle_spans(bundle, args.correlate)
+                 if bundle is not None
+                 else fetch_spans(args.console, args.addr, args.correlate))
         items = correlate(events, spans, args.correlate)
         if args.json:
             print(json.dumps({"trace_id": args.correlate, "items": items},

@@ -130,6 +130,33 @@ def read_amp_summary(before: dict[str, float],
             "read_amp": round(shards / req, 3)}
 
 
+def bundle_window(bundle: dict) -> tuple[dict, dict, dict, float]:
+    """Offline (--bundle) window: the first vs last frozen metric-history
+    snapshot across a bundle's targets, series keys prefixed with the
+    target (a cfs-doctor read_bundle result — one daemon's flat bundle or
+    a console incident dir). Returns (before, after, types, interval_s)."""
+    before: dict[str, float] = {}
+    after: dict[str, float] = {}
+    types: dict[str, str] = {}
+    interval = 0.0
+    for tname, payload in bundle["targets"].items():
+        snaps = (payload.get("metrics") or {}).get("snapshots", [])
+        if not snaps:
+            continue
+        first, last = snaps[0], snaps[-1]
+        interval = max(interval, (last.get("mono") or last.get("ts", 0.0))
+                       - (first.get("mono") or first.get("ts", 0.0)))
+        for k, v in first.get("metrics", {}).items():
+            before[f"{tname}:{k}"] = v
+        for k, v in last.get("metrics", {}).items():
+            after[f"{tname}:{k}"] = v
+        for fam, kind in last.get("types", {}).items():
+            types[f"{tname}:{fam}"] = kind
+    if not after:
+        raise ValueError("bundle froze no metric snapshots")
+    return before, after, types, interval
+
+
 def scrape(addr: str, path: str = "/metrics", timeout: float = 10.0) -> str:
     from chubaofs_tpu.rpc.pool import NullPool
 
@@ -154,7 +181,11 @@ def main(argv=None, out=None) -> int:
     out = out or sys.stdout
     p = argparse.ArgumentParser(
         prog="cfs-stat", description="scrape + diff two /metrics snapshots")
-    p.add_argument("--addr", required=True, help="daemon host:port")
+    p.add_argument("--addr", help="daemon host:port")
+    p.add_argument("--bundle", default="",
+                   help="diff the first vs last frozen metric-history "
+                        "snapshot of a collected flight-recorder bundle "
+                        "instead of scraping live (postmortem mode)")
     p.add_argument("--path", default="/metrics")
     p.add_argument("--interval", type=float, default=5.0,
                    help="seconds between the two snapshots")
@@ -177,21 +208,39 @@ def main(argv=None, out=None) -> int:
                         "print them next to the diff")
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
+    if not args.addr and not args.bundle:
+        p.error("give --addr or --bundle")
 
-    try:
-        t0 = time.monotonic()
-        before = parse_metrics(scrape(args.addr, args.path))
-        time.sleep(max(0.0, args.interval))
-        text = scrape(args.addr, args.path)
-        after = parse_metrics(text)
-        types = parse_types(text)
-        elapsed = time.monotonic() - t0
-    except (OSError, ValueError) as e:
-        print(f"error: {e}", file=sys.stderr)
-        return 1
+    bundle = None
+    if args.bundle:
+        from chubaofs_tpu.tools.cfsdoctor import read_bundle
+
+        try:
+            bundle = read_bundle(args.bundle)
+            before, after, types, elapsed = bundle_window(bundle)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+    else:
+        try:
+            t0 = time.monotonic()
+            before = parse_metrics(scrape(args.addr, args.path))
+            time.sleep(max(0.0, args.interval))
+            text = scrape(args.addr, args.path)
+            after = parse_metrics(text)
+            types = parse_types(text)
+            elapsed = time.monotonic() - t0
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
 
     slowops: list[dict] = []
-    if args.slowops:
+    if args.slowops and bundle is not None:
+        for tname, payload in bundle["targets"].items():
+            slowops.extend({**rec, "target": tname} for rec in
+                           (payload.get("slowops") or {}).get("slowops", []))
+        slowops.sort(key=lambda r: r.get("ts", ""))
+    elif args.slowops:
         # /api/slowops first: on a console that's the cluster-wide rollup
         # (its local /slowops is an empty log), on a master the same local
         # data; plain daemons 404 it and fall back to /slowops

@@ -435,6 +435,10 @@ def main(argv=None, out=None) -> int:
                    help="daemon or console address (repeatable)")
     p.add_argument("--dir", default=None,
                    help="read a local trace-sink directory instead of HTTP")
+    p.add_argument("--bundle", default=None,
+                   help="read spans from a collected flight-recorder "
+                        "bundle dir instead of live side-doors "
+                        "(postmortem mode)")
     p.add_argument("--top", action="store_true",
                    help="per-hop p50/p99 over recent traces")
     p.add_argument("--prof", type=float, default=None, metavar="SECONDS",
@@ -475,14 +479,33 @@ def main(argv=None, out=None) -> int:
 
     if not args.top and not args.trace_id:
         p.error("a trace id is required unless --top")
-    if not args.addr and not args.dir:
+    if not args.addr and not args.dir and not args.bundle:
         env_dir = os.environ.get("CFS_TRACE_DIR")
         if env_dir:
             args.dir = env_dir
         else:
-            p.error("give --addr (repeatable) or --dir (or set CFS_TRACE_DIR)")
+            p.error("give --addr (repeatable), --dir, or --bundle "
+                    "(or set CFS_TRACE_DIR)")
 
-    if args.dir:
+    if args.bundle:
+        from chubaofs_tpu.tools.cfsdoctor import read_bundle
+
+        try:
+            bundle = read_bundle(args.bundle)
+        except (OSError, ValueError) as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        seen: dict[str, dict] = {}
+        for payload in bundle["targets"].values():
+            for rec in (payload.get("traces") or {}).get("records", []):
+                if args.trace_id and rec.get("trace_id") != args.trace_id:
+                    continue
+                if rec.get("span_id"):
+                    seen.setdefault(rec["span_id"], rec)
+        records = sorted(seen.values(), key=lambda r: r.get("start", 0.0))
+        if args.top:
+            records = records[-args.n:]
+    elif args.dir:
         records = read_dir(args.dir, args.trace_id)
         if args.top:
             records = records[-args.n:]

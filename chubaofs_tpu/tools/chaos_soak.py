@@ -89,7 +89,8 @@ def main(argv=None) -> int:
         except SoakFailure as e:
             ok = False
             res = {"plan": "meta_split", "seed": args.seed, "ok": False,
-                   "error": str(e)}
+                   "error": str(e),
+                   "bundle": getattr(e, "bundle", None)}
         results.append(res)
     if args.cache:
         root = (os.path.join(args.root, "cache-soak") if args.root
@@ -99,7 +100,8 @@ def main(argv=None) -> int:
         except SoakFailure as e:
             ok = False
             res = {"plan": "cache", "seed": args.seed, "ok": False,
-                   "error": str(e)}
+                   "error": str(e),
+                   "bundle": getattr(e, "bundle", None)}
         results.append(res)
     if args.kill_blobnode:
         root = (os.path.join(args.root, "kill-blobnode") if args.root
@@ -111,7 +113,8 @@ def main(argv=None) -> int:
         except SoakFailure as e:
             ok = False
             res = {"plan": "kill_blobnode", "seed": args.seed, "ok": False,
-                   "error": str(e)}
+                   "error": str(e),
+                   "bundle": getattr(e, "bundle", None)}
         results.append(res)
     for plan in plans:
         runs = 2 if args.verify_repro else 1
@@ -130,7 +133,8 @@ def main(argv=None) -> int:
             except SoakFailure as e:
                 ok = False
                 res = {"plan": plan, "seed": args.seed, "ok": False,
-                       "error": str(e)}
+                       "error": str(e),
+                       "bundle": getattr(e, "bundle", None)}
             logs.append(res.get("events"))
             results.append(res)
             if not res.get("ok"):
@@ -177,6 +181,9 @@ def main(argv=None) -> int:
                          f" gets={r.get('gets')}"
                          f" max_get={r.get('max_get_s', 0):.2f}s")
             print(f"[{status}] plan={r['plan']} seed={r.get('seed')} {extra}")
+            if r.get("bundle"):
+                print(f"         incident bundle: {r['bundle']} "
+                      f"(cfs-doctor inspect)")
             for ev in r.get("events") or []:
                 print(f"         t={ev['t']} {ev['event']} {ev['fault']}"
                       + "".join(f" {k}={v}" for k, v in ev.items()

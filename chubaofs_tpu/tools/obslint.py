@@ -60,6 +60,16 @@
    line); `tools/`/`cli/` stdout-stderr is the user interface, as in rule 6.
    A reasoned `# obslint: <why>` pragma documents a true protocol line.
 
+8. **Every `EVENT_TYPES` name has an emit site.** The journal's type set is
+   a closed contract: the runtime validator accepts exactly these names,
+   dashboards and tests filter on them, and cfs-events documents them. A
+   type nobody emits is a dead promise that silently rots the timeline —
+   nothing can ever appear under it, and readers can't tell "quiet" from
+   "unwired". This is a package-GLOBAL pass (`lint_event_types`): a name
+   counts as covered when a string literal reaches any `*emit*(...)` call's
+   first argument (including computed `"a" if c else "b"` forms) or an
+   `etype`-named assignment anywhere in the package.
+
 Wired into tier-1 (tests/test_obslint.py) so a regression fails fast.
 
 File-walk, pragma, and CLI plumbing live in tools/lintcore.py, shared with
@@ -289,9 +299,62 @@ def lint_source(src: str, relpath: str) -> list[str]:
     return findings
 
 
+def _emit_literals(tree: ast.AST) -> set[str]:
+    """Every string literal that can reach an emit call in this module: a
+    literal anywhere inside a Call whose callee name/attr mentions `emit`
+    (covers `events.emit("x", ...)`, `self._emit_bp("x", ...)`, and the
+    IfExp form `ev.emit("a" if c else "b", ...)`), plus literals assigned
+    to an `etype`-named variable (the alert plane computes the type first,
+    then emits it)."""
+    out: set[str] = set()
+
+    def literals_under(node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+                out.add(sub.value)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            name = (fn.attr if isinstance(fn, ast.Attribute)
+                    else fn.id if isinstance(fn, ast.Name) else "")
+            if "emit" in name and node.args:
+                literals_under(node.args[0])
+        elif isinstance(node, ast.Assign):
+            if any(isinstance(t, ast.Name) and "etype" in t.id
+                   for t in node.targets):
+                literals_under(node.value)
+    return out
+
+
+def lint_event_types(root: str | None = None) -> list[str]:
+    """Rule 8, a package-GLOBAL pass (per-file rules can't see it): every
+    name in `events.EVENT_TYPES` must have at least one emit site somewhere
+    in the package. A type with no emitter is a dead timeline contract —
+    dashboards and tests filter on it, the runtime validator accepts it,
+    and nothing can ever appear."""
+    from chubaofs_tpu.utils.events import EVENT_TYPES
+
+    emitted: set[str] = set()
+    for abspath, relpath in lintcore.iter_py_files(
+            root or lintcore.package_root()):
+        try:
+            with open(abspath, encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=relpath)
+        except (OSError, SyntaxError):
+            continue
+        emitted |= _emit_literals(tree)
+    return [f"utils/events.py: EVENT_TYPES entry `{t}` has no emit( site "
+            f"in the package — a dead event type silently rots the "
+            f"timeline contract (emit it or prune it)"
+            for t in EVENT_TYPES if t not in emitted]
+
+
 def run(root: str | None = None) -> list[str]:
-    """Lint every .py file under the package; returns all findings."""
-    return lintcore.run_package(lint_source, root)
+    """Lint every .py file under the package (rules 1-7), then the
+    package-global event-type coverage pass (rule 8); returns all
+    findings."""
+    return lintcore.run_package(lint_source, root) + lint_event_types(root)
 
 
 def main(argv=None) -> int:

@@ -1556,6 +1556,89 @@ def bench_events(root: str, n_events: int = 10_000, puts: int = 6,
     return out
 
 
+def bench_flightrec(root: str, puts: int = 8, blob_kb: int = 64) -> dict:
+    """Flight-recorder disarm floor (ISSUE 18): zero cost until armed AND
+    firing.
+
+    The recorder is threadless and hook-driven — with CFS_FLIGHT unset
+    activate_from_env() touches nothing, so a PUT/GET burst must see
+    (a) no flight/recorder thread anywhere in the process, (b) zero
+    bundles on disk, and (c) the armed-but-quiescent arm of the A/B
+    within noise of the disarmed arm: arming only registers an alert
+    hook, which costs nothing until an alert transition actually fires.
+    Thread or bundle leakage is a correctness failure, so the bench
+    raises rather than just reporting a number."""
+    import threading
+
+    from chubaofs_tpu.blobstore.cluster import MiniCluster
+    from chubaofs_tpu.utils import flightrec
+
+    flight_dir = os.path.join(root, "flight")
+    prev = {k: os.environ.pop(k, None)
+            for k in ("CFS_FLIGHT", "CFS_FLIGHT_DIR")}
+    out: dict = {}
+    try:
+        flightrec.deactivate()
+        c = MiniCluster(os.path.join(root, "frcluster"), n_nodes=6)
+        try:
+            payload = os.urandom(blob_kb * 1024)
+            warm = c.access.put(payload)  # jit/vuid creation off the clock
+            assert c.access.get(warm) == payload
+
+            def burst_med_ms() -> float:
+                lat = []
+                for _ in range(puts):
+                    t0 = time.perf_counter()
+                    loc = c.access.put(payload)
+                    if c.access.get(loc) != payload:
+                        raise AssertionError("flightrec burst miscompare")
+                    lat.append(time.perf_counter() - t0)
+                lat.sort()
+                return round(lat[len(lat) // 2] * 1000, 2)
+
+            out["flightrec_disarmed_med_ms"] = burst_med_ms()
+            stray = [t.name for t in threading.enumerate()
+                     if "flight" in t.name.lower()
+                     or "recorder" in t.name.lower()]
+            if stray:
+                raise AssertionError(
+                    f"disarmed flight recorder owns threads {stray} — the "
+                    f"design is threadless; nothing may spin when "
+                    f"CFS_FLIGHT is unset")
+            if os.path.isdir(flight_dir) and os.listdir(flight_dir):
+                raise AssertionError(
+                    f"disarmed burst wrote bundles: {os.listdir(flight_dir)}")
+
+            # armed-but-quiescent arm: the hook is registered, no alert
+            # fires, so the hot path must be indistinguishable
+            os.environ["CFS_FLIGHT"] = "1"
+            os.environ["CFS_FLIGHT_DIR"] = flight_dir
+            flightrec.activate_from_env()
+            out["flightrec_armed_med_ms"] = burst_med_ms()
+            bundles = (os.listdir(flight_dir)
+                       if os.path.isdir(flight_dir) else [])
+            out["flightrec_quiescent_bundles"] = len(bundles)
+            if bundles:
+                raise AssertionError(
+                    f"armed-but-quiescent burst wrote bundles {bundles} — "
+                    f"capture must only follow an alert transition or an "
+                    f"explicit trigger")
+        finally:
+            c.close()
+    finally:
+        flightrec.deactivate()
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    log(f"  flightrec: burst med disarmed "
+        f"{out['flightrec_disarmed_med_ms']}ms vs armed-quiescent "
+        f"{out['flightrec_armed_med_ms']}ms, bundles "
+        f"{out['flightrec_quiescent_bundles']}, recorder threads 0")
+    return out
+
+
 def run(root: str, n_files: int = 600, n_clients: int = 4,
         stream_mb: int = 64, metanodes: int = 3, datanodes: int = 3) -> dict:
     from chubaofs_tpu.testing.harness import ProcCluster
@@ -1563,6 +1646,8 @@ def run(root: str, n_files: int = 600, n_clients: int = 4,
     cfg: dict = {}
     log("event plane (emission overhead + hot-path zero-events)...")
     cfg.update(bench_events(os.path.join(root, "eventsbench")))
+    log("flight recorder (disarmed zero-overhead floor)...")
+    cfg.update(bench_flightrec(os.path.join(root, "flightbench")))
     log("raft commit (group-commit microbench)...")
     cfg.update(bench_raft_commit(os.path.join(root, "raftbench"), n_ops=n_files))
     log("blobstore data-path pipeline (PUT overlap + pooled RPC A/B)...")

@@ -109,6 +109,30 @@ def fingerprint(rule_name: str, labels: dict | None) -> str:
         f"|{k}={v}" for k, v in sorted((labels or {}).items()))
 
 
+# -- firing hooks (the alert-lifecycle subscription, ROADMAP item 4) -----------
+#
+# Callbacks run AFTER the firing transition's event+counter, outside the
+# manager lock, on the evaluating thread — cb(fingerprint, instance_report).
+# Private managers (soak probes) never invoke them, same as they never
+# publish the cfs_alerts_firing gauge: a probe's synthetic windows must not
+# trigger the serving process's incident machinery. A raising hook is
+# swallowed — subscribers must not kill the evaluator.
+
+_firing_hooks: list = []
+
+
+def on_firing(cb) -> None:
+    if cb not in _firing_hooks:
+        _firing_hooks.append(cb)
+
+
+def remove_firing_hook(cb) -> None:
+    try:
+        _firing_hooks.remove(cb)
+    except ValueError:
+        pass
+
+
 @dataclass
 class _Instance:
     rule: AlertRule
@@ -290,6 +314,16 @@ class AlertManager:
                                 "description": inst.rule.description})
             reg.counter("transitions",
                         {"rule": inst.rule.name, "state": state}).add()
+        if not self.private:
+            for state, inst in transitions:
+                if state != STATE_FIRING:
+                    continue
+                fp = fingerprint(inst.rule.name, inst.labels)
+                for cb in list(_firing_hooks):
+                    try:
+                        cb(fp, inst.report())
+                    except Exception:
+                        pass  # a subscriber must not kill the evaluator
         return self.report()
 
     def _prune_resolved_locked(self) -> None:

@@ -81,6 +81,7 @@ EVENT_TYPES = (
     "alert_resolved",       # a firing alert cleared
     "qos_throttle",         # gateway QoS throttled a tenant (episode, 1/s)
     "bench_tick",           # perfbench events-overhead smoke traffic
+    "incident_capture",     # flight recorder froze a capture bundle
 )
 
 _SEV_RANK = {SEV_INFO: 0, SEV_WARNING: 1, SEV_CRITICAL: 2}

@@ -210,3 +210,42 @@ def test_flags_sendall_of_encoded_packet():
             "    sock.sendall(json.dumps(cmd).encode())\n"
             "    sock.sendall(line.encode())\n")
     assert obslint.lint_source(text, "sdk/somewhere.py") == []
+
+
+def test_event_type_without_emit_site_is_flagged(monkeypatch):
+    """Rule 8: a name in EVENT_TYPES with no emit( site anywhere in the
+    package is a dead timeline contract — inject a phantom entry and the
+    package-global pass must flag exactly it (everything real stays
+    covered, per test_repo_is_clean)."""
+    from chubaofs_tpu.utils import events
+
+    monkeypatch.setattr(events, "EVENT_TYPES",
+                        tuple(events.EVENT_TYPES) + ("phantom_event",))
+    findings = obslint.lint_event_types()
+    assert len(findings) == 1, findings
+    assert "phantom_event" in findings[0]
+    assert "no emit( site" in findings[0]
+
+
+def test_emit_literal_extraction_covers_the_emit_shapes():
+    """Rule 8's collector must see every shape the package emits through:
+    a plain emit() call, an attr-named emitter (self._emit_bp), a
+    conditional type expression inside emit(), and the compute-then-emit
+    `etype = ...` form — while ignoring unrelated string literals."""
+    import ast
+
+    src = textwrap.dedent("""
+        def f(self, ev, cond):
+            ev.emit("plain_type", detail={"k": 1})
+            self._emit_bp("attr_type", 2)
+            ev.emit("a_type" if cond else "b_type")
+            etype = "assigned_type"
+            ev.emit(etype)
+            unrelated = "not_an_event"
+            log("also_not_an_event")
+    """)
+    lits = obslint._emit_literals(ast.parse(src))
+    assert {"plain_type", "attr_type", "a_type", "b_type",
+            "assigned_type"} <= lits
+    assert "not_an_event" not in lits
+    assert "also_not_an_event" not in lits

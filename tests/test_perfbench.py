@@ -84,6 +84,21 @@ def test_events_overhead_floor(tmp_path):
     assert out["events_emit_us_avg"] > 0, out
 
 
+def test_flightrec_disarmed_overhead_floor(tmp_path):
+    """Tier-1 flight-recorder gate (ISSUE 18 satellite): with CFS_FLIGHT
+    unset a PUT/GET burst spins no recorder thread and writes no bundle,
+    and arming the hook without an alert firing leaves both burst medians
+    measured and the bundle dir empty. The bench itself raises on any
+    thread or bundle leakage, so this is a correctness gate, not just a
+    timing floor."""
+    from chubaofs_tpu.tools.perfbench import bench_flightrec
+
+    out = bench_flightrec(str(tmp_path), puts=4, blob_kb=32)
+    assert out["flightrec_quiescent_bundles"] == 0, out
+    assert out["flightrec_disarmed_med_ms"] > 0, out
+    assert out["flightrec_armed_med_ms"] > 0, out
+
+
 @pytest.mark.slow
 def test_perfbench_tool_runs_and_gates(tmp_path):
     # own session so a timeout kill reaps the 7 daemon GRANDCHILDREN too —
