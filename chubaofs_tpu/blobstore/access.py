@@ -962,8 +962,15 @@ class Access:
         have = dict(have or {})
         slow = set(deprioritize or ())
         failed = set(failed or ())
-        out = self._degraded_window(t, vol, blob, shard_len, offset, size,
-                                    have, slow, failed)
+        if t.is_regenerating:
+            # PM sub-unit layout: a shard-byte window couples to a column
+            # range in EVERY one of the survivor's alpha sub-units, which
+            # the single-range windowed gather can't express — regenerating
+            # stripes take the full-stripe path (any-N decode) directly
+            out = None
+        else:
+            out = self._degraded_window(t, vol, blob, shard_len, offset,
+                                        size, have, slow, failed)
         if out is not None:
             return out
         return self._degraded_full(t, vol, blob, shard_len, offset, size,
@@ -1171,7 +1178,8 @@ class Access:
                 f"blob {blob.bid}: only {len(present)} shards readable, need {t.N}"
             )
         t_dec = time.perf_counter()
-        fixed = self.codec.reconstruct(t.N, t.M, stripe, missing, data_only=True).result()
+        fixed = self.codec.reconstruct_tactic(
+            t, stripe, missing, data_only=True).result()
         registry("access").counter("read_bytes", {"kind": "decoded"}).add(
             sum(shard_len for i in missing if i < t.N))
         if span is not None:

@@ -599,6 +599,51 @@ class BlobNode:
                 self._iostat.read_done(
                     len(data), int((_time.perf_counter() - t0) * 1e6))
 
+    def get_shard_combined(self, vuid: int, bid: int, coeffs: bytes) -> bytes:
+        """Beta-combine helper read for regenerating-code repair: read the
+        whole local shard, combine its len(coeffs) equal sub-units with the
+        failed shard's GF(2^8) coefficients (codec/pm.py helper math), and
+        return the single shard/len(coeffs)-byte payload. The disk still
+        reads the full shard (iostat shows that truth); what shrinks is the
+        bytes shipped to the repair worker — the cross-node cost repair
+        bandwidth actually pays.
+        """
+        import time as _time
+
+        import numpy as np
+
+        from chubaofs_tpu.ops import gf256
+
+        t0 = _time.perf_counter()
+        data = b""
+        if self._iostat is not None:
+            self._iostat.read_begin()
+        try:
+            with self._reg.tp("shard_get"):
+                # same failpoint as get_shard: wire-delay/error chaos regimes
+                # apply to beta reads and full reads alike
+                chaos.failpoint("blobnode.get_shard", node=self.node_id)
+                data = self._disk_io(
+                    vuid, lambda: self._chunk(vuid).get(bid, 0, None))
+            buf = np.frombuffer(data, np.uint8)
+            if not coeffs or buf.size % len(coeffs):
+                raise BlobNodeError(
+                    f"shard {len(data)}B not divisible into "
+                    f"{len(coeffs)} sub-units")
+            phi = np.frombuffer(coeffs, np.uint8)[None, :]
+            out = gf256.gf_matmul(phi, buf.reshape(len(coeffs), -1)).tobytes()
+            # count the SHIPPED bytes, like get_shard does — the beta win
+            # must be visible in the node's own byte counters
+            self._reg.counter("shard_get_bytes_total").add(len(out))
+            self._reg.counter("shard_combine_bytes_total").add(len(out))
+            return chaos.corrupt_bytes("blobnode.get_shard.data", out,
+                                       node=self.node_id)
+        finally:
+            if self._iostat is not None:
+                # the disk truly read the whole shard; iostat records that
+                self._iostat.read_done(
+                    len(data), int((_time.perf_counter() - t0) * 1e6))
+
     def mark_delete_shard(self, vuid: int, bid: int) -> None:
         self._chunk(vuid).mark_delete(bid)
 

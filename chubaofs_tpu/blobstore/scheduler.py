@@ -933,8 +933,8 @@ class RepairWorker:
             stripe, present, _ = self._gather(vol, t, task.bid, span=span)
             missing = [i for i in range(t.N + t.M) if i not in present]
             if missing:
-                stripe = self.codec.reconstruct(
-                    t.N, t.M, stripe, missing, data_only=True).result()
+                stripe = self.codec.reconstruct_tactic(
+                    t, stripe, missing, data_only=True).result()
             payload = stripe[: t.N].reshape(-1).tobytes()
         if task.size > 0:
             payload = payload[: task.size]  # strip the EC stripe padding
@@ -1029,6 +1029,15 @@ class RepairWorker:
             unhandled = self._repair_local_stripes(vol, t, bid, unhandled)
             if not unhandled:
                 return
+        if t.is_regenerating and len(unhandled) == 1:
+            # the repair-traffic win: a single loss under a regenerating
+            # mode downloads d beta payloads, not N full shards. Multi-loss
+            # (or any helper failure) falls through to the generic gather.
+            if self._repair_regenerating(vol, t, bid, unhandled[0]):
+                return
+        elif t.is_regenerating and len(unhandled) > 1:
+            registry("scheduler").counter(
+                "repair_beta_fallback", {"reason": "multi_loss"}).add()
         self._repair_global(vol, t, bid)
 
     def _repair_local_stripes(self, vol: VolumeInfo, t, bid: int,
@@ -1072,7 +1081,7 @@ class RepairWorker:
         stripe, present, shard_len = self._gather(vol, t, bid, span=span)
         missing = [i for i in range(t.N + t.M) if i not in present]
         if missing:
-            fixed = self.codec.reconstruct(t.N, t.M, stripe, missing).result()
+            fixed = self.codec.reconstruct_tactic(t, stripe, missing).result()
             for idx in missing:
                 self._write_back(vol, idx, bid, fixed[idx].tobytes())
             stripe = fixed
@@ -1113,7 +1122,7 @@ class RepairWorker:
             raise ConnectionError(f"node {unit.node_id} unknown")
         return node.get_shard(unit.vuid, bid)
 
-    def _drain_reads(self, futs: dict, out: dict) -> list:
+    def _drain_reads(self, futs: dict, out: dict, need: int | None = None) -> list:
         """Drain a {key: Future-of-bytes} fan-out under ONE shared
         read_deadline: successes land in `out` and feed the repair-traffic
         byte accounting; absent/unreachable/hung reads are returned as
@@ -1121,10 +1130,17 @@ class RepairWorker:
         (cfs_scheduler_probe_fail{reason}) so a silent hang and a real bug
         stop being indistinguishable. The one timeout/cancel/classify
         block both _probe and _copy_direct ride — their semantics must
-        never diverge."""
+        never diverge.
+
+        `need` is how many successes the decode strictly requires: bytes
+        beyond it are HEDGES (straggler insurance) and count to
+        repair_bytes_hedged instead of repair_bytes_downloaded, so
+        bytes-per-repaired-shard stays an honest numerator. None = every
+        read is required."""
         reg = registry("scheduler")
         deadline = time.monotonic() + self.read_deadline
         leftover = []
+        got = 0
         for key, f in futs.items():
             try:
                 data = f.result(timeout=max(0.0, deadline - time.monotonic()))
@@ -1139,11 +1155,15 @@ class RepairWorker:
                 leftover.append(key)
                 continue
             out[key] = data
-            reg.counter("repair_bytes_downloaded").add(len(data))
+            got += 1
+            if need is not None and got > need:
+                reg.counter("repair_bytes_hedged").add(len(data))
+            else:
+                reg.counter("repair_bytes_downloaded").add(len(data))
         return leftover
 
     def _probe(self, vol: VolumeInfo, bid: int, idxs,
-               span=None) -> dict[int, bytes]:
+               span=None, need: int | None = None) -> dict[int, bytes]:
         """Read the given stripe positions CONCURRENTLY via _drain_reads;
         the whole fan-out lands on the span as a `download` stage."""
         idxs = list(idxs)
@@ -1153,14 +1173,16 @@ class RepairWorker:
         futs = {i: self._shard_pool.submit(self._read_one, vol, i, bid)
                 for i in idxs}
         reads: dict[int, bytes] = {}
-        self._drain_reads(futs, reads)
+        self._drain_reads(futs, reads, need=need)
         if span is not None:
             span.add_stage("download", start=t0)
         return reads
 
     def _gather(self, vol: VolumeInfo, t, bid: int, span=None):
-        """Read every readable global shard of a stripe; infer shard_len."""
-        reads = self._probe(vol, bid, range(t.N + t.M), span=span)
+        """Read every readable global shard of a stripe; infer shard_len.
+        Decode needs only N rows — the extra M reads are hedges and are
+        accounted as such (_drain_reads need=N)."""
+        reads = self._probe(vol, bid, range(t.N + t.M), span=span, need=t.N)
         if len(reads) < t.N:
             raise RuntimeError(f"stripe {vol.vid}/{bid}: {len(reads)} < N={t.N} readable")
         shard_len = len(next(iter(reads.values())))
@@ -1168,6 +1190,87 @@ class RepairWorker:
         for idx, data in reads.items():
             stripe[idx] = np.frombuffer(data, np.uint8)
         return stripe, sorted(reads), shard_len
+
+    # -- beta-fetch repair (regenerating modes, codec/pm.py) -------------------
+
+    def _read_combined(self, vol: VolumeInfo, idx: int, bid: int,
+                       coeffs: bytes) -> bytes:
+        unit = vol.units[idx]
+        node = self.nodes.get(unit.node_id)
+        if node is None:
+            raise ConnectionError(f"node {unit.node_id} unknown")
+        return node.get_shard_combined(unit.vuid, bid, coeffs)
+
+    def _gather_beta(self, vol: VolumeInfo, t, bid: int, fail: int,
+                     span=None):
+        """Beta-fetch gather for a SINGLE lost shard of a regenerating
+        stripe: the layout-aware helper set (Tactic.helper_set — same-AZ
+        first) each ships its beta = shard/alpha combined payload
+        (BlobNode.get_shard_combined). Returns (helpers, payloads (d, beta))
+        or None when the survivors can't field d helpers or any helper read
+        fails — the caller then falls back to the full-stripe gather, which
+        needs only N of the survivors."""
+        from chubaofs_tpu.codec import pm
+
+        reg = registry("scheduler")
+
+        def usable(i: int) -> bool:
+            u = vol.units[i]
+            if u.node_id not in self.nodes:
+                return False
+            d = self.cm.disks.get(u.disk_id)
+            return d is None or d.status == DISK_NORMAL
+
+        alive = [i for i in range(t.global_count)
+                 if i != fail and usable(i)]
+        helpers = t.helper_set(fail, alive)
+        if not helpers:
+            reg.counter("repair_beta_fallback",
+                        {"reason": "helpers_short"}).add()
+            return None
+        kernel = pm.get_kernel(t.total, t.N)
+        coeffs = kernel.helper_coeffs(fail).tobytes()
+        t0 = time.perf_counter()
+        futs = {i: self._shard_pool.submit(
+                    self._read_combined, vol, i, bid, coeffs)
+                for i in helpers}
+        reads: dict[int, bytes] = {}
+        # every helper is load-bearing (the repair matrix inverts exactly
+        # these d rows): need=len so none of these bytes count as hedged
+        self._drain_reads(futs, reads, need=len(helpers))
+        if span is not None:
+            span.add_stage("download", start=t0)
+        if len(reads) < len(helpers):
+            reg.counter("repair_beta_fallback", {"reason": "read_fail"}).add()
+            return None
+        payloads = np.stack(
+            [np.frombuffer(reads[i], np.uint8) for i in helpers])
+        from chubaofs_tpu.codec.codemode import CodeMode
+
+        reg.counter("repair_helper_bytes",
+                    {"mode": CodeMode(vol.code_mode).name}).add(
+            int(payloads.size))
+        return helpers, payloads
+
+    def _repair_regenerating(self, vol: VolumeInfo, t, bid: int,
+                             fail: int) -> bool:
+        """Single-loss beta repair: d combined sub-shard reads, ONE
+        (alpha, d) matmul decode through the codec service, write back.
+        Returns False (nothing written) when the beta path can't run —
+        _repair_global then handles the stripe generically."""
+        from chubaofs_tpu.codec import pm
+
+        span = trace.current_span()
+        got = self._gather_beta(vol, t, bid, fail, span=span)
+        if got is None:
+            return False
+        helpers, payloads = got
+        kernel = pm.get_kernel(t.total, t.N)
+        mat = kernel.repair_matrix(fail, helpers)
+        fixed = self.codec.matmul(mat, payloads).result()
+        self._write_back(vol, fail, bid, fixed.reshape(-1).tobytes())
+        registry("scheduler").counter("repair_beta_shards").add()
+        return True
 
     # -- disk-level migrate (bulk; the 10k-stripe batch path) ------------------
 
@@ -1257,19 +1360,59 @@ class RepairWorker:
                 for bid in bids}
         return self._drain_reads(futs, rows)
 
+    def _gather_for_unit(self, vol: VolumeInfo, t, unit, bid: int,
+                         span=None):
+        """Mode-aware stripe gather for the migrate/rebuild pipeline: a
+        regenerating volume first tries the beta-fetch for the migrating
+        unit's row (d combined payloads instead of a full-stripe gather —
+        the bulk-rebuild path is where nearly all repair bytes move) and
+        falls back to the full gather when helpers can't cover it."""
+        if t.is_regenerating and unit.index < t.global_count:
+            got = self._gather_beta(vol, t, bid, unit.index, span=span)
+            if got is not None:
+                return ("beta",) + got
+        return ("full", self._gather(vol, t, bid, span=span))
+
     def _stripe_row(self, vol: VolumeInfo, t, unit, bid: int, gathered,
                     rows: dict[int, bytes], futures: dict[int, object]):
         """Turn one gathered stripe into the migrating unit's row: a present
         survivor copies, a lost global shard becomes a (batchable) device
-        reconstruct future, a lost local parity re-encodes its AZ stripe."""
-        stripe, present, _ = gathered
+        reconstruct future, a lost local parity re-encodes its AZ stripe.
+        A beta-gather (regenerating modes) becomes the (alpha, d) repair
+        matmul — batchable on the device exactly like the RS decodes."""
+        from concurrent.futures import Future
+
+        if gathered[0] == "beta":
+            _, helpers, payloads = gathered
+            from chubaofs_tpu.codec import pm
+
+            kernel = pm.get_kernel(t.total, t.N)
+            mat = kernel.repair_matrix(unit.index, helpers)
+            mm = self.codec.matmul(mat, payloads)
+            # _commit_unit resolves futures as result()[unit.index]: deliver
+            # the single rebuilt row under that key (a dict indexes the same
+            # way a full stripe array does)
+            out: Future = Future()
+            idx = unit.index
+
+            def _fin(f: Future, out=out, idx=idx):
+                if f.exception():
+                    out.set_exception(f.exception())
+                else:
+                    out.set_result({idx: f.result().reshape(-1)})
+
+            mm.add_done_callback(_fin)
+            futures[bid] = out
+            registry("scheduler").counter("repair_beta_shards").add()
+            return
+        stripe, present, _ = gathered[1]
         missing = [i for i in range(t.N + t.M) if i not in present]
         if unit.index in present:
             rows[bid] = stripe[unit.index].tobytes()
         elif unit.index < t.global_count:
             # repair with the FULL missing set: zero-filled absent rows
             # must never be treated as survivors
-            futures[bid] = self.codec.reconstruct(t.N, t.M, stripe, missing)
+            futures[bid] = self.codec.reconstruct_tactic(t, stripe, missing)
         else:
             # LRC local parity: complete the globals, then re-encode
             # this AZ's local stripe to regenerate the lost row
@@ -1302,7 +1445,8 @@ class RepairWorker:
         if window <= 1:
             for bid in bids:
                 self._stripe_row(vol, t, unit, bid,
-                                 self._gather(vol, t, bid, span=span),
+                                 self._gather_for_unit(vol, t, unit, bid,
+                                                       span=span),
                                  rows, futures)
             return
 
@@ -1312,7 +1456,7 @@ class RepairWorker:
             if span is not None:
                 trace.push_span(span)
             try:
-                return self._gather(vol, t, bid, span=span)
+                return self._gather_for_unit(vol, t, unit, bid, span=span)
             finally:
                 if span is not None:
                     trace.pop_span()

@@ -297,9 +297,16 @@ def run_kill_soak(root: str, seed: int, n_nodes: int = 9,
                   live_puts: int = 8, hb_timeout: float = 0.75,
                   wire_ms: float = 2.0, read_deadline: float = 0.5,
                   write_deadline: float = 4.0, max_wait_s: float = 120.0,
-                  sizes: list[int] | None = None) -> dict:
+                  sizes: list[int] | None = None,
+                  mode: int | str | None = None) -> dict:
     """Kill a blobnode under live PUT load; the repair plane must notice and
     rebuild (the ISSUE-7 acceptance scenario).
+
+    `mode` pins every PUT to one CodeMode (name or value; None = cluster
+    default) — the ISSUE-19 axis: soaking RG6P6 drives the rebuild through
+    the beta-fetch plane (and its multi-loss full-gather fallback when the
+    killed node held two units of a stripe), under the SAME byte-identical
+    read-back and convergence invariants as the default mode.
 
     Phases: warm PUTs land acked blobs -> a seeded node_kill closes one
     engine and removes it from routing (its heartbeats stop) -> the
@@ -331,7 +338,11 @@ def run_kill_soak(root: str, seed: int, n_nodes: int = 9,
 
     from chubaofs_tpu.utils import events as ev
 
+    from chubaofs_tpu.codec.codemode import CodeMode
+
     sizes = sizes or SIZES
+    if isinstance(mode, str):
+        mode = CodeMode[mode]
     rnd = random.Random(seed)
     rng = np.random.default_rng(seed)
     c = MiniCluster(root, n_nodes=n_nodes, disks_per_node=disks_per_node)
@@ -364,6 +375,7 @@ def run_kill_soak(root: str, seed: int, n_nodes: int = 9,
     reg = registry("scheduler")
     shards0 = reg.counter("repaired_shards").value
     bytes0 = reg.counter("repair_bytes_downloaded").value
+    beta0 = reg.counter("repair_beta_shards").value
     live: dict[int, tuple] = {}
     next_id = 0
     stats = {"puts": 0, "puts_rejected": 0, "live_puts": 0}
@@ -371,7 +383,7 @@ def run_kill_soak(root: str, seed: int, n_nodes: int = 9,
     def put_one(data: bytes) -> bool:
         nonlocal next_id
         try:
-            live[next_id] = (c.access.put(data), data)
+            live[next_id] = (c.access.put(data, code_mode=mode), data)
             next_id += 1
             stats["puts"] += 1
             return True
@@ -549,6 +561,9 @@ def run_kill_soak(root: str, seed: int, n_nodes: int = 9,
                 best_report = critical_path([rec])
         return {
             "plan": "kill_blobnode", "seed": seed, "ok": True,
+            "code_mode": CodeMode(mode).name if mode is not None else None,
+            "beta_shards": int(
+                reg.counter("repair_beta_shards").value - beta0),
             "events": list(sched.events), "killed_node": killed,
             "detect_s": round((t_detect or t_done) - t_kill, 3),
             "rebuild_s": round(rebuild_s, 3),

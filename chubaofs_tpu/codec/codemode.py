@@ -40,6 +40,11 @@ class CodeMode(enum.IntEnum):
     # scalar images recoverable through the ordinary reconstruct path.
     # Never size-selected: blobs enter only via tier promotion.
     Replica3 = 15
+    # repair-traffic regenerating code (ISSUE 19): product-matrix MSR
+    # RG(n=12, k=6, d=10, alpha=5) — single-shard repair downloads
+    # d*(shard/alpha) = 2 shard-equivalents instead of RS's 12. Systematic
+    # (data shards are raw blob bytes), rate 1/2.
+    RG6P6 = 16
     # test-only modes (kept for parity with the reference's table)
     EC6P6L9 = 200
     EC6P8L10 = 201
@@ -48,6 +53,8 @@ class CodeMode(enum.IntEnum):
     EC20P4L2 = 202
     # BASELINE.json unit-bench config (plain RS 4+2, single AZ)
     EC4P2 = 203
+    # small regenerating mode for fast tests: RG(n=8, k=4, d=6, alpha=3)
+    RG4P4 = 204
 
 
 @dataclass(frozen=True)
@@ -61,6 +68,13 @@ class Tactic:
     put_quorum: int
     get_quorum: int = 0
     min_shard_size: int = ALIGN_2KB
+    # regenerating-code geometry (0/1 = plain RS/LRC): sub_units is the
+    # per-shard sub-unit count alpha (a shard is alpha equal slices; the
+    # repair beta-payload is ONE slice), helpers is d, the helper count a
+    # single-loss repair reads from. Product-matrix MSR fixes alpha = N-1,
+    # helpers = 2N-2 (codec/pm.py).
+    sub_units: int = 1
+    helpers: int = 0
 
     @property
     def total(self) -> int:
@@ -81,11 +95,31 @@ class Tactic:
     def global_count(self) -> int:
         return self.N + self.M
 
+    @property
+    def is_regenerating(self) -> bool:
+        """True for product-matrix regenerating modes (beta-fetch repair)."""
+        return self.sub_units > 1
+
+    def beta_size(self, shard_size: int) -> int:
+        """Bytes ONE helper ships for a single-shard repair: shard/alpha."""
+        if shard_size % self.sub_units:
+            raise ValueError(
+                f"shard size {shard_size} not divisible by "
+                f"sub_units={self.sub_units}")
+        return shard_size // self.sub_units
+
     def is_valid(self) -> bool:
         if self.N <= 0 or self.M <= 0 or self.L < 0 or self.az_count <= 0:
             return False
         if self.N % self.az_count or self.M % self.az_count or self.L % self.az_count:
             return False
+        if self.is_regenerating:
+            # PM-MSR geometry: alpha = N-1, d = 2N-2, a single loss must
+            # leave d helpers, and LRC locals don't compose with beta-fetch
+            if self.L or self.sub_units != self.N - 1:
+                return False
+            if self.helpers != 2 * self.N - 2 or self.total - 1 < self.helpers:
+                return False
         # quorum bound: (N+M)/AZCount + N <= PutQuorum <= M+N (codemode.go:137-140)
         return self.put_quorum <= self.N + self.M
 
@@ -130,11 +164,41 @@ class Tactic:
         return [(self.shards_in_az(az), local_n, local_m) for az in range(self.az_count)]
 
     def shard_size(self, blob_size: int) -> int:
-        """Per-shard byte size when splitting a blob (codemode.go:142-158)."""
+        """Per-shard byte size when splitting a blob (codemode.go:142-158).
+
+        Regenerating modes round up to a multiple of sub_units so every
+        shard slices into alpha equal sub-units (the beta-payload unit).
+        """
         if blob_size <= 0:
             raise ValueError(f"blob_size {blob_size}")
         size = -(-blob_size // self.N)  # ceil div
-        return max(size, self.min_shard_size)
+        size = max(size, self.min_shard_size)
+        if self.sub_units > 1:
+            size = -(-size // self.sub_units) * self.sub_units
+        return size
+
+    def helper_set(self, fail: int, alive: list[int]) -> list[int]:
+        """The layout-aware helper pick for a single-shard beta-fetch repair:
+        which d survivors ship their beta payload for failed shard `fail`.
+
+        Policy: prefer helpers in the failed shard's own AZ (repair traffic
+        stays local), then ring-distance-closest AZs, index order within an
+        AZ for determinism. Returns [] when the survivors can't cover d —
+        the caller then falls back to the full-stripe gather.
+        """
+        if not self.is_regenerating:
+            return []
+        cand = [i for i in alive if i != fail and i < self.global_count]
+        if len(cand) < self.helpers:
+            return []
+        az_f = self.az_of_shard(fail)
+        ring = self.az_count
+
+        def rank(i: int) -> tuple[int, int]:
+            dist = abs(self.az_of_shard(i) - az_f)
+            return (min(dist, ring - dist), i)
+
+        return sorted(cand, key=rank)[: self.helpers]
 
 
 _TACTICS: dict[CodeMode, Tactic] = {
@@ -154,6 +218,10 @@ _TACTICS: dict[CodeMode, Tactic] = {
     # hot tier: exact-size shards (ALIGN_0B) so replica shard 0 == blob
     CodeMode.Replica3: Tactic(1, 2, 0, 1, put_quorum=2,
                               min_shard_size=ALIGN_0B),
+    # regenerating: PM-MSR n=12/k=6/d=10/alpha=5 — repair ships 10 beta
+    # payloads (2 shard-equivalents) instead of 12 full shards
+    CodeMode.RG6P6: Tactic(6, 6, 0, 1, put_quorum=11,
+                           sub_units=5, helpers=10),
     # env/test modes
     CodeMode.EC6P3L3: Tactic(6, 3, 3, 3, put_quorum=9),
     CodeMode.EC6P6Align0: Tactic(6, 6, 0, 3, put_quorum=11, min_shard_size=ALIGN_0B),
@@ -163,6 +231,8 @@ _TACTICS: dict[CodeMode, Tactic] = {
     CodeMode.EC6P8L10: Tactic(6, 8, 10, 2, put_quorum=13, min_shard_size=ALIGN_0B),
     CodeMode.EC20P4L2: Tactic(20, 4, 2, 2, put_quorum=22),
     CodeMode.EC4P2: Tactic(4, 2, 0, 1, put_quorum=5),
+    CodeMode.RG4P4: Tactic(4, 4, 0, 1, put_quorum=7,
+                           sub_units=3, helpers=6),
 }
 
 

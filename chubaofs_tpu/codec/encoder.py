@@ -287,6 +287,58 @@ class LrcEncoder(RsEncoder):
         return list(shards[t.global_count : t.total])
 
 
+class PmEncoder(RsEncoder):
+    """Product-matrix MSR encoder for regenerating code modes (codec/pm.py).
+
+    Same verb surface as RsEncoder — systematic, so split/join and the data
+    shards are bit-identical to RsEncoder's at equal shard size. What
+    changes is the repair math: reconstruct decodes from any N intact
+    nodes via the PM generator, and the kernel additionally exposes the
+    beta-fetch single-loss path (helper_payload/repair) the repair plane
+    uses through the scheduler.
+    """
+
+    def __init__(self, cfg: EncoderConfig):
+        self.cfg = cfg
+        self.tactic = cfg.tactic
+        t = self.tactic
+        if not t.is_valid():
+            raise ValueError(f"invalid code-mode tactic {t}")
+        if not t.is_regenerating:
+            raise ValueError("PmEncoder requires a regenerating tactic")
+        from chubaofs_tpu.codec import pm
+
+        self.kernel = pm.get_kernel(t.total, t.N)
+
+    def encode(self, shards: Sequence) -> None:
+        t = self.tactic
+        _check_writable(shards, range(t.N, t.total))
+        mat = _as_matrix(shards, t.total)
+        if mat.shape[1] % t.sub_units:
+            raise InvalidShardsError(
+                f"shard size {mat.shape[1]} not a multiple of "
+                f"sub_units={t.sub_units}")
+        full = self.kernel.encode(mat[: t.N])
+        if self.cfg.enable_verify and not self.kernel.verify(full):
+            raise VerifyError("post-encode verify failed")
+        _writeback(shards, full, range(t.N, t.total))
+
+    def verify(self, shards: Sequence) -> bool:
+        mat = _as_matrix(shards, self.tactic.total)
+        return bool(self.kernel.verify(mat))
+
+    def _reconstruct(self, shards, bad_idx, data_only: bool) -> None:
+        if not bad_idx:
+            return
+        t = self.tactic
+        target = [i for i in bad_idx if i < t.N] if data_only else list(bad_idx)
+        _check_writable(shards, target)
+        mat = _as_matrix(shards, t.total)
+        fixed = self.kernel.reconstruct(mat, list(bad_idx),
+                                        data_only=data_only)
+        _writeback(shards, fixed, target)
+
+
 @functools.lru_cache(maxsize=32)
 def lrc_parity_matrix(t: Tactic) -> np.ndarray:
     """Composed (M+L, N) GF(2^8) generator: global parity rows plus every AZ's
@@ -318,11 +370,14 @@ def lrc_parity_matrix(t: Tactic) -> np.ndarray:
 
 
 # the reference interface name, for drop-in reading of call sites
-Encoder = RsEncoder | LrcEncoder
+Encoder = RsEncoder | LrcEncoder | PmEncoder
 
 
-def new_encoder(cfg: EncoderConfig | CodeMode | int | str, **kw) -> RsEncoder | LrcEncoder:
-    """NewEncoder equivalent (encoder.go:78-112): picks RS vs LRC by tactic.L."""
+def new_encoder(cfg: EncoderConfig | CodeMode | int | str, **kw) -> Encoder:
+    """NewEncoder equivalent (encoder.go:78-112): picks RS vs LRC by
+    tactic.L, and the product-matrix encoder for regenerating tactics."""
     if not isinstance(cfg, EncoderConfig):
         cfg = EncoderConfig(code_mode=get_tactic(cfg), **kw)
+    if cfg.tactic.is_regenerating:
+        return PmEncoder(cfg)
     return LrcEncoder(cfg) if cfg.tactic.L else RsEncoder(cfg)

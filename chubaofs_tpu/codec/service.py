@@ -137,10 +137,32 @@ class CodecService:
         self._submit(job)
         return job.future
 
+    def matmul(self, mat: np.ndarray, data: np.ndarray) -> Future:
+        """Generic GF(2^8) matmul job: data (rows, k) uint8 ->
+        Future[(mat.shape[0], k) uint8]. The raw entry the regenerating-code
+        paths ride: PM parity blocks, beta-repair decodes, and any-k
+        fallback decodes are all just content-keyed matrices, so they batch
+        on the device exactly like RS repairs."""
+        mat = np.ascontiguousarray(mat, np.uint8)
+        data = np.asarray(data, np.uint8)
+        if data.ndim != 2 or mat.ndim != 2 or data.shape[0] != mat.shape[1]:
+            raise ValueError(
+                f"matmul shape mismatch: mat {mat.shape} @ data {data.shape}")
+        k = data.shape[1]
+        kb = bucket_len(k)
+        job = _Job("matmul", data.shape[0], mat.shape[0],
+                   _pad_to_bucket(data, k, kb), k, kb, mat=mat)
+        self._submit(job)
+        return job.future
+
     def encode_tactic(self, t, data: np.ndarray) -> Future:
         """data (N, k) uint8 -> Future[(total, k) full stripe], local parities
         included for LRC tactics — computed in ONE composed-matrix matmul
-        (encoder.lrc_parity_matrix), not a second device pass."""
+        (encoder.lrc_parity_matrix), not a second device pass. Regenerating
+        tactics run their PM parity block the same way: one matmul over the
+        stripe's sub-unit rows."""
+        if t.is_regenerating:
+            return self._encode_pm(t, data)
         if not t.L:
             return self.encode(t.N, t.M, data)
         from chubaofs_tpu.codec.encoder import lrc_parity_matrix
@@ -176,6 +198,81 @@ class CodecService:
 
         job.future.add_done_callback(_finish)
         return out
+
+    def _encode_pm(self, t, data: np.ndarray) -> Future:
+        """Product-matrix encode: shard rows reshaped (free) to sub-unit
+        rows, parity block applied as one matmul, parity rows reshaped back
+        to shards. Same snapshot discipline as the LRC path."""
+        from chubaofs_tpu.codec import pm
+
+        if data.shape[0] != t.N:
+            raise ValueError(f"want {t.N} data rows, got {data.shape}")
+        size = data.shape[1]
+        if size % t.sub_units:
+            raise ValueError(
+                f"shard size {size} not a multiple of sub_units={t.sub_units}")
+        data = np.array(data, np.uint8, order="C")
+        kernel = pm.get_kernel(t.total, t.N)
+        f = self.matmul(kernel.parity_mat,
+                        data.reshape(t.N * t.sub_units, -1))
+        out = _ChainFuture(f)
+
+        def _finish(fut: Future):
+            if fut.cancelled() or out.cancelled():
+                return
+            try:
+                if fut.exception():
+                    out.set_exception(fut.exception())
+                else:
+                    parity = fut.result().reshape(t.M, size)
+                    out.set_result(np.concatenate([data, parity], axis=0))
+            except InvalidStateError:
+                pass  # out.cancel() raced the delivery: outcome discarded
+
+        f.add_done_callback(_finish)
+        return out
+
+    def reconstruct_tactic(self, t, shards: np.ndarray, bad_idx: list[int],
+                           data_only: bool = False) -> Future:
+        """Tactic-aware full-stripe rebuild: RS/LRC global stripes use the
+        windowed RS repair matrix; regenerating stripes decode from any N
+        intact nodes via the PM generator (the multi-loss fallback — the
+        single-loss beta-fetch path lives in the scheduler)."""
+        if not t.is_regenerating:
+            return self.reconstruct(t.N, t.M, shards, bad_idx, data_only)
+        from chubaofs_tpu.codec import pm
+
+        kernel = pm.get_kernel(t.total, t.N)
+        bad = sorted(set(int(i) for i in bad_idx))
+        want = [i for i in bad if i < t.N] if data_only else bad
+        if not want:
+            f: Future = Future()
+            f.set_result(np.array(shards, copy=True))
+            return f
+        alive = [i for i in range(t.total) if i not in bad]
+        if len(alive) < t.N:
+            f = Future()
+            f.set_exception(ValueError(
+                f"{len(bad)} losses > M={t.M} for regenerating stripe"))
+            return f
+        srv = alive[: t.N]
+        mat = kernel.decode_matrix(srv, want)
+        shards = np.asarray(shards, np.uint8)
+        size = shards.shape[1]
+        job_f = self.matmul(
+            mat, shards[np.asarray(srv)].reshape(t.N * t.sub_units, -1))
+        out_future: Future = Future()
+
+        def _finish(fut: Future):
+            if fut.exception():
+                out_future.set_exception(fut.exception())
+                return
+            fixed = np.array(shards, copy=True)
+            fixed[np.asarray(want)] = fut.result().reshape(len(want), size)
+            out_future.set_result(fixed)
+
+        job_f.add_done_callback(_finish)
+        return out_future
 
     def reconstruct(
         self, n: int, m: int, shards: np.ndarray, bad_idx: list[int], data_only=False

@@ -130,6 +130,48 @@ def read_amp_summary(before: dict[str, float],
             "read_amp": round(shards / req, 3)}
 
 
+def repair_summary(before: dict[str, float],
+                   after: dict[str, float]) -> dict | None:
+    """Window repair-traffic rollup from two snapshots (--repair, ISSUE 19):
+    bytes-per-repaired-shard derived from the downloaded-bytes and
+    repaired-shards counter deltas, restart-clamped per series, plus the
+    hedged-byte and beta-path shares and per-mode helper bytes. None when
+    the window repaired nothing — callers print `-` (idle) rather than a
+    bogus 0.0 ratio."""
+    def fam_of(key: str) -> str:
+        # strip labels, then any bundle target prefix ("node1:cfs_...")
+        return key.split("{", 1)[0].rsplit(":", 1)[-1]
+
+    def fam_delta(fam: str) -> float:
+        tot = 0.0
+        for key, a in after.items():
+            if fam_of(key) != fam:
+                continue
+            d = a - before.get(key, 0.0)
+            tot += a if d < 0 else d
+        return tot
+
+    shards = fam_delta("cfs_scheduler_repaired_shards")
+    if shards <= 0:
+        return None
+    dl = fam_delta("cfs_scheduler_repair_bytes_downloaded")
+    helper: dict[str, float] = {}
+    for key, a in after.items():
+        if (fam_of(key) == "cfs_scheduler_repair_helper_bytes"
+                and 'mode="' in key):
+            m = key.split('mode="', 1)[1].split('"', 1)[0]
+            d = a - before.get(key, 0.0)
+            helper[m] = helper.get(m, 0.0) + (a if d < 0 else d)
+    return {
+        "repaired_shards": shards,
+        "downloaded_bytes": dl,
+        "hedged_bytes": fam_delta("cfs_scheduler_repair_bytes_hedged"),
+        "beta_shards": fam_delta("cfs_scheduler_repair_beta_shards"),
+        "helper_bytes": {k: v for k, v in helper.items() if v > 0},
+        "bytes_per_repaired_shard": round(dl / shards, 1),
+    }
+
+
 def bundle_window(bundle: dict) -> tuple[dict, dict, dict, float]:
     """Offline (--bundle) window: the first vs last frozen metric-history
     snapshot across a bundle's targets, series keys prefixed with the
@@ -270,10 +312,13 @@ def main(argv=None, out=None) -> int:
     elif not args.all:
         rows = [r for r in rows if r["delta"] != 0]
     amp = read_amp_summary(before, after) if args.reads else None
+    rep = repair_summary(before, after) if args.repair else None
     if args.json:
         blob = {"interval_s": round(elapsed, 3), "rows": rows}
         if amp is not None:
             blob["read_amp"] = amp
+        if args.repair:
+            blob["repair"] = rep
         if args.slowops:
             blob["slowops"] = slowops
         print(json.dumps(blob, indent=2), file=out)
@@ -307,6 +352,20 @@ def main(argv=None, out=None) -> int:
               f"(shards_read {amp['shards_read_bytes']:g}B / "
               f"requested {amp['requested_bytes']:g}B; "
               f"decoded {amp['decoded_bytes']:g}B)", file=out)
+    if args.repair:
+        if rep is None:
+            print("\nbytes/repaired-shard: -  (no shards repaired this "
+                  "window)", file=out)
+        else:
+            helper = "".join(
+                f", helper[{m}] {v:g}B"
+                for m, v in sorted(rep["helper_bytes"].items()))
+            print(f"\nbytes/repaired-shard: "
+                  f"{rep['bytes_per_repaired_shard']:g}  "
+                  f"(downloaded {rep['downloaded_bytes']:g}B / "
+                  f"{rep['repaired_shards']:g} shards; "
+                  f"hedged {rep['hedged_bytes']:g}B, "
+                  f"beta {rep['beta_shards']:g}{helper})", file=out)
     return 0
 
 

@@ -28,6 +28,12 @@ one row per daemon target:
     load-split signal), rendered `parts/hot`; '-' when the target hosts
     no meta partitions;
   * REPAIRQ — repair tasks outstanding (`cfs_scheduler_tasks` gauge sum);
+  * REPB/SH — repair-traffic cost over the window: bytes downloaded per
+    repaired shard (`cfs_scheduler_repair_bytes_downloaded` /
+    `cfs_scheduler_repaired_shards` deltas, restart-clamped; hedged bytes
+    excluded by the scheduler's accounting); '-' when the window repaired
+    nothing — regenerating modes (RG6P6) show this well under the RS
+    k-shard cost;
   * UP — seconds since the daemon's `cfs_boot_time_seconds` boot stamp. A
     boot stamp that MOVED between frames is a confirmed restart — the row
     tags `(restart)` from that cross-check, not just from negative-delta
@@ -57,7 +63,7 @@ from chubaofs_tpu.utils.slo import FAILING, RANK
 
 COLUMNS = ("TARGET", "SLO", "UP", "PUT/S", "GET/S", "PUT99MS", "CONNS",
            "BP/S", "LAG99", "CODEC/B", "CACHE%", "RDAMP", "THR%", "META",
-           "REPAIRQ", "ALERTS")
+           "REPAIRQ", "REPB/SH", "ALERTS")
 
 
 # -- scraping ------------------------------------------------------------------
@@ -262,6 +268,12 @@ def compute_row(target: str, prev: dict | None, cur: dict | None,
     # above, so a metanode's first frame still renders `N/-`
     row["meta_hot_ops"] = _hottest_pid_rate(prev, cur, dt) \
         if row.get("meta_parts") else None
+    # repair traffic (ISSUE 19): window bytes downloaded per repaired
+    # shard; '-' when nothing was repaired this window. _rate with dt=1
+    # gives the restart-clamped window delta, same as the cache cell.
+    rep_sh = _rate(prev, cur, "cfs_scheduler_repaired_shards", 1.0)
+    rep_b = _rate(prev, cur, "cfs_scheduler_repair_bytes_downloaded", 1.0)
+    row["repair_bps"] = round(rep_b / rep_sh, 1) if rep_sh > 0 else None
     return row
 
 
@@ -307,7 +319,8 @@ def render(rows: list[dict], errors: list[str] = ()) -> str:
               _cell(r.get("codec_occ")), _cell(r.get("cache_pct")),
               _cell(r.get("read_amp")),
               _cell(r.get("thr_pct")), _meta_cell(r),
-              _cell(r.get("repair_q")), _cell(r.get("alerts"))]
+              _cell(r.get("repair_q")), _cell(r.get("repair_bps")),
+              _cell(r.get("alerts"))]
              for r in rows]
     widths = [max(len(COLUMNS[i]), max(len(row[i]) for row in cells))
               for i in range(len(COLUMNS))]

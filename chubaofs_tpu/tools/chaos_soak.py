@@ -57,6 +57,11 @@ def main(argv=None) -> int:
                         "tiered read cache with failpoint-DELAYED "
                         "invalidation; fails on any stale or corrupt byte "
                         "(crc ledger) or a deleted blob still readable")
+    p.add_argument("--mode", default=None,
+                   help="pin every PUT of the kill scenario to one CodeMode "
+                        "by name (e.g. RG6P6 to soak the beta-fetch repair "
+                        "plane, EC12P4 for the RS baseline); default: the "
+                        "cluster's default mode")
     p.add_argument("--hb-timeout", type=float, default=0.75,
                    help="heartbeat-silence window for the kill scenario's "
                         "dead-disk detection (seconds)")
@@ -109,7 +114,7 @@ def main(argv=None) -> int:
         try:
             res = run_kill_soak(root, seed=args.seed, n_nodes=args.nodes,
                                 disks_per_node=args.disks_per_node,
-                                hb_timeout=args.hb_timeout)
+                                hb_timeout=args.hb_timeout, mode=args.mode)
         except SoakFailure as e:
             ok = False
             res = {"plan": "kill_blobnode", "seed": args.seed, "ok": False,
@@ -169,11 +174,15 @@ def main(argv=None) -> int:
                          f"moved={r.get('migrate_moved')} "
                          f"kills={[k['phase'] for k in r.get('kills', [])]}")
             elif r.get("plan") == "kill_blobnode":
-                extra = (f"killed={r['killed_node']} "
+                extra = ((f"mode={r['code_mode']} " if r.get("code_mode")
+                          else "")
+                         + f"killed={r['killed_node']} "
                          f"detect={r['detect_s']}s "
                          f"rebuilt={r['rebuilt_shards']} shards "
                          f"({r['rebuild_shards_per_s']}/s) "
-                         f"overlap={r['repair_overlap_ratio']} "
+                         + (f"beta={r['beta_shards']} "
+                            if r.get("beta_shards") else "")
+                         + f"overlap={r['repair_overlap_ratio']} "
                          f"bytes/shard={r['bytes_per_repaired_shard']}")
             else:
                 extra = (f"puts={r.get('puts')} "

@@ -663,6 +663,116 @@ def bench_repair(root: str, n_nodes: int = 6, disks_per_node: int = 2,
     return out
 
 
+def bench_repair_codes(root: str, n_nodes: int = 17, stripes: int = 12,
+                       blob_kb: int = 120, wire_ms: float = 2.0,
+                       window: int = 4) -> dict:
+    """Repair-traffic A/B (ISSUE 19): identical blob bytes rebuilt off a
+    killed node under the product-matrix regenerating code RG6P6 (β-fetch:
+    d=10 helpers each ship a GF-combined shard/5 slice, 2 shard-equivalents
+    per row) vs classic RS EC12P4 (k=12 full shards per row). One disk per
+    node so the kill loses exactly ONE unit per stripe — the single-loss
+    regime the β path exists for; a two-disk node would alias two stripe
+    positions onto the victim and silently turn the RG phase into its own
+    multi-loss fallback. Same wire regime and byte-identical read-back
+    rules as bench_repair. Hedged bytes are excluded from the numerator by
+    the scheduler's need-aware accounting, so bytes-per-repaired-shard is
+    pure required traffic. Emits per-mode bytes/shard, download
+    amplification (bytes downloaded / bytes rebuilt — shard sizes differ
+    across modes, amplification doesn't), stripes/s, overlap ratio, and
+    the headline reduction the acceptance gate rides (>=25%; the geometry
+    predicts ~67% on bytes/shard, ~83% on amplification)."""
+    from chubaofs_tpu import chaos
+    from chubaofs_tpu.blobstore.cluster import MiniCluster
+    from chubaofs_tpu.blobstore.clustermgr import DISK_BROKEN
+    from chubaofs_tpu.codec.codemode import CodeMode, get_tactic
+    from chubaofs_tpu.utils import exporter
+
+    reg = exporter.registry("scheduler")
+
+    def phase(label: str, mode: CodeMode, payloads: list[bytes]) -> dict:
+        c = MiniCluster(os.path.join(root, label), n_nodes=n_nodes,
+                        disks_per_node=1)
+        try:
+            c.worker.set_repair_window(window)
+            locs = [c.access.put(p, code_mode=mode) for p in payloads]
+            load = {n: 0 for n in c.nodes}
+            for d in c.cm.disks.values():
+                load[d.node_id] = load.get(d.node_id, 0) + d.chunk_count
+            victim = max(load, key=load.get)
+            c.nodes.pop(victim).close()
+            for d in c.cm.disks.values():
+                if d.node_id == victim:
+                    c.cm.set_disk_status(d.disk_id, DISK_BROKEN)
+            shards0 = reg.counter("repaired_shards").value
+            bytes0 = reg.counter("repair_bytes_downloaded").value
+            beta0 = reg.counter("repair_beta_shards").value
+            ov0 = reg.summary("repair_overlap_ratio",
+                              buckets=exporter.RATIO_BUCKETS).snapshot()
+            if wire_ms > 0:
+                chaos.arm("blobnode.get_shard", f"delay({wire_ms / 1000.0})")
+            t0 = time.perf_counter()
+            try:
+                c.scheduler.check_disks()
+                while c.worker.run_once():
+                    pass
+                dt = time.perf_counter() - t0
+            finally:
+                if wire_ms > 0:
+                    chaos.disarm("blobnode.get_shard")
+            rebuilt = int(reg.counter("repaired_shards").value - shards0)
+            dl = int(reg.counter("repair_bytes_downloaded").value - bytes0)
+            ov1 = reg.summary("repair_overlap_ratio",
+                              buckets=exporter.RATIO_BUCKETS).snapshot()
+            for loc, p in zip(locs, payloads):
+                assert c.access.get(loc) == p, \
+                    f"repaired stripe miscompares ({label})"
+            shard_len = get_tactic(mode).shard_size(blob_kb * 1024)
+            n_obs = ov1["count"] - ov0["count"]
+            return {
+                "rows": rebuilt,
+                "stripes_s": round(rebuilt / max(1e-9, dt), 1),
+                "bytes_per_shard": round(dl / max(1, rebuilt), 1),
+                "amp": round(dl / max(1, rebuilt * shard_len), 2),
+                "overlap": round((ov1["sum"] - ov0["sum"]) / n_obs, 3)
+                if n_obs else 0.0,
+                "beta_rows": int(reg.counter("repair_beta_shards").value
+                                 - beta0),
+            }
+        finally:
+            c.close()
+
+    payloads = [os.urandom(blob_kb * 1024) for _ in range(stripes)]
+    # discarded warmup repair: in a full run the RS decode paths arrive
+    # pre-warmed by bench_repair while the PM kernel/bit-matrix lowering
+    # would JIT inside the RG timed region, skewing stripes/s ~3x cold
+    phase("warmup", CodeMode.RG6P6, payloads[:2])
+    rg = phase("rg6p6", CodeMode.RG6P6, payloads)
+    rs = phase("ec12p4", CodeMode.EC12P4, payloads)
+    out = {
+        "repair_codes_rows_rg": rg["rows"],
+        "repair_codes_rows_rs": rs["rows"],
+        "repair_codes_beta_rows": rg["beta_rows"],
+        "repair_codes_bytes_per_shard_rg": rg["bytes_per_shard"],
+        "repair_codes_bytes_per_shard_rs": rs["bytes_per_shard"],
+        "repair_codes_amp_rg": rg["amp"],
+        "repair_codes_amp_rs": rs["amp"],
+        "repair_codes_reduction": round(
+            1.0 - rg["bytes_per_shard"] / max(1.0, rs["bytes_per_shard"]), 3),
+        "repair_codes_amp_reduction": round(
+            1.0 - rg["amp"] / max(0.001, rs["amp"]), 3),
+        "repair_codes_stripes_s_rg": rg["stripes_s"],
+        "repair_codes_stripes_s_rs": rs["stripes_s"],
+        "repair_codes_overlap_rg": rg["overlap"],
+        "repair_codes_overlap_rs": rs["overlap"],
+    }
+    log(f"  repair-codes: RG6P6 {rg['bytes_per_shard']} B/shard "
+        f"(amp x{rg['amp']}) vs EC12P4 {rs['bytes_per_shard']} B/shard "
+        f"(amp x{rs['amp']}) -> -{out['repair_codes_reduction'] * 100:.0f}% "
+        f"bytes, {rg['stripes_s']}/s vs {rs['stripes_s']}/s, "
+        f"overlap {rg['overlap']}/{rs['overlap']}")
+    return out
+
+
 def _conc_driver(addr: str, n_socks: int, ops: int, payload: int) -> None:
     """Subprocess body for bench_concurrency's load generator. Runs OUT of
     the server's process: an in-process driver shares the server's GIL, and
@@ -1722,6 +1832,15 @@ def run(root: str, n_files: int = 600, n_clients: int = 4,
                                  clients_axis=(32, 128), ops_per_client=6))
     log("gateway QoS fairness (noisy tenant vs victim tenant)...")
     cfg.update(bench_qos_fairness(os.path.join(root, "qosroot")))
+    # repair-traffic codes A/B rides the same post-ProcCluster slot (floor-
+    # deflation lesson): two more MiniClusters + a node kill each would
+    # throttle-deflate the md/stream floors if they ran ahead of them
+    log("repair-traffic codes (RG6P6 beta-fetch vs EC12P4 A/B)...")
+    if n_files >= 300:
+        cfg.update(bench_repair_codes(os.path.join(root, "repaircodes")))
+    else:  # smoke invocations get a smoke-size A/B
+        cfg.update(bench_repair_codes(os.path.join(root, "repaircodes"),
+                                      stripes=4, blob_kb=60))
     _dump_metrics(cfg)
     return cfg
 

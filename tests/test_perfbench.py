@@ -64,6 +64,29 @@ def test_repair_bench_smoke_floor(tmp_path):
     assert out["repair_bytes_per_shard"] > 0, out
 
 
+def test_repair_codes_bench_smoke_floor(tmp_path):
+    """Tier-1 repair-traffic gate (ISSUE 19 satellite): the RG6P6-vs-EC12P4
+    A/B at smoke size must rebuild the same row count on both arms, rebuild
+    EVERY RG row through the beta path (single-loss regime by construction:
+    one disk per node), and cut bytes-per-repaired-shard by at least the
+    25% acceptance floor (geometry predicts 67%; the byte counters are
+    deterministic, so unlike stripes/s this IS CI-assertable). Download
+    amplification must likewise drop (2x vs 12x predicted). Stripes/s
+    floors stay in PERF.md — CI co-tenant noise."""
+    from chubaofs_tpu.tools.perfbench import bench_repair_codes
+
+    out = bench_repair_codes(str(tmp_path), stripes=4, blob_kb=60,
+                             wire_ms=2.0, window=4)
+    assert out["repair_codes_rows_rg"] > 0, out
+    assert out["repair_codes_rows_rs"] == out["repair_codes_rows_rg"], out
+    assert out["repair_codes_beta_rows"] == out["repair_codes_rows_rg"], out
+    assert out["repair_codes_reduction"] >= 0.25, out
+    assert out["repair_codes_amp_rg"] < out["repair_codes_amp_rs"], out
+    assert out["repair_codes_stripes_s_rg"] > 0, out
+    assert out["repair_codes_stripes_s_rs"] > 0, out
+    assert out["repair_codes_overlap_rg"] > 0, out
+
+
 def test_events_overhead_floor(tmp_path):
     """Tier-1 events gate (ISSUE 13 satellite): emitting 10k journal events
     (ring + rotating JSONL + counters) stays under a generous wall budget,
