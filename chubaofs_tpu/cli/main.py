@@ -55,6 +55,7 @@ def table(rows: list[dict], columns: list[str], out) -> None:
 class CLI:
     def __init__(self, addrs: list[str], out=None, as_json: bool = False,
                  ticket: str | None = None):
+        self.addrs = list(addrs)
         self.mc = MasterClient(addrs, admin_ticket=ticket)
         self.out = out or sys.stdout
         self.as_json = as_json
@@ -256,15 +257,73 @@ class CLI:
                                        grant=not args.none)
         self._emit(u)
 
+    # -- autopilot (ISSUE 20) --------------------------------------------------
+
+    def _autopilot_call(self, op: str | None = None,
+                        off: bool = False) -> dict:
+        """The /autopilot side-door (plain JSON, not the admin envelope):
+        first reachable configured master wins."""
+        from chubaofs_tpu.tools.cfsstat import scrape
+
+        path = "/autopilot"
+        if op:
+            path += f"?op={op}" + ("&off=1" if off else "")
+        last_err: Exception | None = None
+        for addr in self.addrs:
+            try:
+                return json.loads(scrape(addr, path, timeout=5))
+            except Exception as e:
+                last_err = e
+        raise MasterError(f"no /autopilot endpoint reachable: {last_err}")
+
+    def _autopilot_render(self, st: dict) -> None:
+        if self.as_json:
+            return self._emit(st)
+        mode = "dry-run" if st.get("dry_run") else \
+            ("enabled" if st.get("enabled") else "disabled")
+        b = st.get("budget") or {}
+        print(f"Autopilot : {mode}", file=self.out)
+        print(f"Budget    : {b.get('remaining', 0)}/{b.get('per_hour', 0)} "
+              "action(s) remaining this hour", file=self.out)
+        cooldowns = st.get("cooldowns") or {}
+        rows = [{"binding": x["name"], "rule": x["rule"],
+                 "actuator": x["actuator"],
+                 "armed": "yes" if x.get("armed") else "no",
+                 "cooldown_s": cooldowns.get(x["actuator"], "")}
+                for x in st.get("bindings", [])]
+        table(rows, ["binding", "rule", "actuator", "armed", "cooldown_s"],
+              self.out)
+        decisions = st.get("decisions") or []
+        if decisions:
+            print("Recent decisions:", file=self.out)
+            rows = [{"decision": d.get("decision"),
+                     "binding": d.get("binding"),
+                     "fingerprint": d.get("fingerprint")}
+                    for d in decisions[-10:]]
+            table(rows, ["decision", "binding", "fingerprint"], self.out)
+
+    def autopilot_status(self, args):
+        self._autopilot_render(self._autopilot_call())
+
+    def autopilot_enable(self, args):
+        self._autopilot_render(self._autopilot_call("enable"))
+
+    def autopilot_disable(self, args):
+        self._autopilot_render(self._autopilot_call("disable"))
+
+    def autopilot_dry_run(self, args):
+        self._autopilot_render(self._autopilot_call("dry-run", off=args.off))
+
 
 COMPLETION = """# bash completion for cfs-cli
 _cfs_cli() {
   local cur prev nouns verbs
   cur="${COMP_WORDS[COMP_CWORD]}"
   prev="${COMP_WORDS[COMP_CWORD-1]}"
-  nouns="cluster vol metanode datanode metapartition datapartition user config completion"
+  nouns="cluster vol metanode datanode metapartition datapartition user autopilot config completion"
   case "$prev" in
     cluster) verbs="info topology" ;;
+    autopilot) verbs="status enable disable dry-run" ;;
     vol) verbs="create list info delete" ;;
     metanode|datanode) verbs="list decommission rebalance" ;;
     metapartition) verbs="list" ;;
@@ -377,6 +436,15 @@ def build_parser() -> argparse.ArgumentParser:
                     choices=["readonly", "writable"])
     up.add_argument("--none", action="store_true", help="revoke")
     up.set_defaults(fn="user_perm")
+
+    ap = sub.add_parser("autopilot").add_subparsers(dest="verb", required=True)
+    ap.add_parser("status").set_defaults(fn="autopilot_status")
+    ap.add_parser("enable").set_defaults(fn="autopilot_enable")
+    ap.add_parser("disable").set_defaults(fn="autopilot_disable")
+    adr = ap.add_parser("dry-run")
+    adr.add_argument("--off", action="store_true",
+                     help="leave shadow mode (actions run again)")
+    adr.set_defaults(fn="autopilot_dry_run")
 
     cfg = sub.add_parser("config").add_subparsers(dest="verb", required=True)
     cs = cfg.add_parser("set")

@@ -243,6 +243,16 @@ class MasterDaemon(_Daemon):
         if self.rebalance_meta_secs > 0:
             self._every(self.rebalance_meta_secs, self._rebalance_meta,
                         f"master{self.node_id}-metarebalance")
+        # autopilot (ISSUE 20): when CFS_AUTOPILOT armed the controller
+        # at RPCServer boot, hand it the master's sweep actuators — the
+        # hot-partition alert → rebalance closed loop
+        from chubaofs_tpu import autopilot as _ap
+
+        if _ap.enabled_from_env():
+            ctl = _ap.default_controller()
+            for act in _ap.master_actuators(
+                    self.master, factor=self.rebalance_hot_factor):
+                ctl.register(act)
 
     def _rebalance_hot(self):
         if self.master.is_leader:

@@ -262,6 +262,27 @@ class Console:
             return Response.json(fetch_alerts(
                 None, self.master_addrs + self.metrics_addrs, timeout=3.0))
 
+        def autopilot_rollup(req: Request):
+            """Every target's /autopilot controller state, per-target rows
+            plus the cluster action-budget totals — which daemon is armed,
+            what it decided lately. Unreachable targets are reported, and
+            a target answering `enabled: false` is a DISARMED row, not an
+            error (most daemons never arm a controller)."""
+            rows, missed = [], []
+            remaining = per_hour = 0
+            for addr, out in _fanout("/autopilot"):
+                if out is None or "enabled" not in out:
+                    missed.append(addr)
+                    continue
+                rows.append({"target": addr, **out})
+                b = out.get("budget") or {}
+                remaining += int(b.get("remaining", 0) or 0)
+                per_hour += int(b.get("per_hour", 0) or 0)
+            return Response.json(
+                {"targets": rows, "unreachable": missed,
+                 "enabled": any(r.get("enabled") for r in rows),
+                 "budget": {"remaining": remaining, "per_hour": per_hour}})
+
         def slowops_rollup(req: Request):
             """Recent slow-op audit entries from every daemon, each tagged
             with its source target — what `cfs-stat --slowops` renders next
@@ -326,6 +347,7 @@ class Console:
         r.get("/api/slowops", slowops_rollup)
         r.get("/api/events", events_rollup)
         r.get("/api/alerts", alerts_rollup)
+        r.get("/api/autopilot", autopilot_rollup)
         r.get("/api/incident", incident_rollup)
         r.post("/graphql", graphql_proxy)
         return r

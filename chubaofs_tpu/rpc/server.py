@@ -246,6 +246,34 @@ class RPCServer:
 
             return Response.json(alerts.alerts_report())
 
+        def autopilot_route(r):
+            from chubaofs_tpu.autopilot import controller as ap_ctl
+
+            op = r.q("op")
+            if op:
+                ap = ap_ctl.default_controller()
+                if op == "enable":
+                    ap.attach().set_enabled(True)
+                    if not ap.armed:
+                        ap.start(ap_ctl._env_f("CFS_AUTOPILOT_TICK_S", 5.0))
+                elif op == "disable":
+                    ap.set_enabled(False)
+                elif op == "dry-run":
+                    # arm in shadow mode (decisions logged, nothing runs);
+                    # ?off=1 drops back to live actuation
+                    ap.set_dry_run(not r.q("off"))
+                    if not r.q("off"):
+                        ap.attach().set_enabled(True)
+                        if not ap.armed:
+                            ap.start(
+                                ap_ctl._env_f("CFS_AUTOPILOT_TICK_S", 5.0))
+                else:
+                    return Response.json(
+                        {"error": f"unknown op {op!r} (enable | disable "
+                                  "| dry-run)"}, status=400)
+                return Response.json(ap.status())
+            return Response.json(ap_ctl.autopilot_status())
+
         def debug_bundle_route(r):
             from chubaofs_tpu.utils import flightrec
 
@@ -277,9 +305,11 @@ class RPCServer:
             router.get("/health", health_route)
             router.get("/events", events_route)
             router.get("/alerts", alerts_route)
+            router.get("/autopilot", autopilot_route)
             router.get("/debug/bundle", debug_bundle_route)
             # env-armed sinks go live at daemon boot, not first scrape —
             # and stay the documented no-op when their env knob is unset
+            from chubaofs_tpu.autopilot import controller as _autopilot
             from chubaofs_tpu.utils import alerts, flightrec, metrichist, \
                 profiler, tracesink
 
@@ -288,6 +318,7 @@ class RPCServer:
             metrichist.activate_from_env()
             alerts.activate_from_env()
             flightrec.activate_from_env()
+            _autopilot.activate_from_env()
 
         outer = self
         self._inflight = 0

@@ -33,10 +33,19 @@ sweep (`rebalance_hot`, cmd.py's rebalanceHotSecs knob), and
 on — reporting the per-node ops spread of each so the A/B shows the skew
 the generator created and the spread reduction the actuator bought.
 
+The closed loop (ISSUE 20): `--autopilot` arms a console-fed Autopilot on
+the collector's alert polls — firing alerts map through the declarative
+bindings to MasterClient actuators (rebalance/split), gated by budget,
+cooldown, and flap damping, every decision a typed `autopilot_*` event.
+`--ab-autopilot` runs the control arm (off) then the closed loop (on);
+`--scenario hotspot|tenant-storm|node-kill` injects the canned stress both
+arms must face.
+
     cfs-capacity --seed 7 --duration 20 --out cap.jsonl
     cfs-capacity --seed 7 --failpoints 'blobnode.put_shard=delay(0.08)' \
         --daemon-env CFS_SLO_PUT_P99_MS=20      # must exit nonzero
     cfs-capacity --seed 7 --ab-rebalance --datanodes 5
+    cfs-capacity --seed 7 --scenario hotspot --ab-autopilot --datanodes 5
 """
 
 from __future__ import annotations
@@ -115,12 +124,15 @@ def ramp_factor(frac: float, shape: str) -> float:
 
 def plan_ops(seed: int, n_tenants: int, duration_s: float, base_rate: float,
              zipf_s: float, keys_per_tenant: int = 64, ramp: str = "diurnal",
-             mean_kb: int = 16, hot: bool = False) -> dict:
+             mean_kb: int = 16, hot: bool = False,
+             storm: str | None = None) -> dict:
     """The full open-loop schedule, a pure function of its arguments: a
     seeded arrival process (rate = base_rate x ramp) where each op draws a
     tenant, a blend-weighted kind, a zipf-popular key, and a size. Returns
     {"tenants", "ops", "per_tenant"} — per_tenant is the count audit the
-    determinism test compares run-over-run."""
+    determinism test compares run-over-run. `storm` names one tenant that
+    soaks up 60% of the arrivals (the tenant-storm scenario): the mix stays
+    seeded-deterministic, only the tenant draw is biased."""
     rng = random.Random(seed)
     tenants = [f"t{i}" for i in range(n_tenants)]
     blends: dict[str, list[tuple[str, float]]] = {}
@@ -143,7 +155,10 @@ def plan_ops(seed: int, n_tenants: int, duration_s: float, base_rate: float,
         t_now += rng.expovariate(rate)
         if t_now >= duration_s:
             break
-        tenant = tenants[rng.randrange(n_tenants)]
+        if storm is not None and storm in tenants and rng.random() < 0.6:
+            tenant = storm
+        else:
+            tenant = tenants[rng.randrange(n_tenants)]
         roll = rng.random()
         kind = next(k for k, edge in blends[tenant] if roll <= edge)
         key = bisect.bisect_left(cdf, rng.random())
@@ -582,12 +597,17 @@ class Collector(threading.Thread):
     pair seen failing and the worst status observed."""
 
     def __init__(self, out_path: str, console: str | None = None,
-                 addrs: list[str] | None = None, interval: float = 1.0):
+                 addrs: list[str] | None = None, interval: float = 1.0,
+                 autopilot=None):
         super().__init__(name="cap-collector", daemon=True)
         self.out_path = out_path
         self.console = console
         self.addrs = list(addrs or [])
         self.interval = interval
+        # the console-fed closed loop (ISSUE 20): each alert poll is also
+        # forwarded to an Autopilot's observe_rollup, so the controller
+        # sees the firing↔resolved edges the harness's gate judges by
+        self.autopilot = autopilot
         self._halt = threading.Event()
         self._lock = SanitizedLock(name="capacity.collector")
         self.frames = 0
@@ -637,6 +657,12 @@ class Collector(threading.Thread):
                         self.alerts_fired.setdefault(
                             row["target"], set()).update(names)
             rec["alerts"] = firing
+            if self.autopilot is not None:
+                # the whole rollup, all states: observe_rollup dedups the
+                # firing set itself and derives the resolved edges
+                self.autopilot.observe_rollup(
+                    [a for row in roll.get("targets", ())
+                     for a in row.get("alerts", ())])
         except Exception:
             rec["alerts"] = None
             with self._lock:
@@ -772,11 +798,18 @@ class SpreadMonitor(threading.Thread):
 # -- orchestration -------------------------------------------------------------
 
 
-def run_capacity(args, rebalance: bool, root: str, out_path: str) -> dict:
+def run_capacity(args, rebalance: bool, root: str, out_path: str,
+                 autopilot: bool | None = None) -> dict:
     """One full harness phase: boot a ProcCluster + console, run the seeded
     open-loop workload under the collector, tear down, return the summary
-    (gate verdict + workload ledger + spread)."""
+    (gate verdict + workload ledger + spread). With `autopilot` a
+    console-fed Autopilot rides the Collector's alert polls and drives the
+    master through MasterClient actuators — the closed loop under test."""
     from chubaofs_tpu.testing.harness import ProcCluster
+
+    autopilot = (getattr(args, "autopilot", False)
+                 if autopilot is None else autopilot)
+    scenario = getattr(args, "scenario", "none")
 
     env = {}
     for kv in args.daemon_env:
@@ -823,9 +856,17 @@ def run_capacity(args, rebalance: bool, root: str, out_path: str) -> dict:
             hot_vol = "cap_hot"
         targets = [cluster.access_addr] + cluster.stats_addrs()
         console = cluster.spawn_console(metrics_addrs=targets)
+        # scenario shaping: pure plan-side skew, so the A/B phases see the
+        # identical injected stress (the determinism contract holds — the
+        # scenario only changes plan_ops arguments)
+        zipf_s, ramp, storm = args.zipf_s, args.ramp, None
+        if scenario == "hotspot":
+            zipf_s, ramp = max(zipf_s, 3.0), "spike"
+        elif scenario == "tenant-storm":
+            storm = "t0"
         plan = plan_ops(args.seed, args.tenants, args.duration, args.rate,
-                        args.zipf_s, keys_per_tenant=args.keys,
-                        ramp=args.ramp, hot=hot_vol is not None)
+                        zipf_s, keys_per_tenant=args.keys,
+                        ramp=ramp, hot=hot_vol is not None, storm=storm)
         driver = RemoteDriver(cluster.master_addrs, [cluster.access_addr],
                               "cap_cold", hot_volume=hot_vol)
         if s3_mode:
@@ -840,20 +881,49 @@ def run_capacity(args, rebalance: bool, root: str, out_path: str) -> dict:
                 mc.create_user(f"cap-{t}", ak=ak, sk=sk)
             driver = S3Driver(cluster.s3_addr, s3_creds, inner=driver)
             driver.ensure_buckets()
+        ctl = None
+        if autopilot:
+            from chubaofs_tpu import autopilot as ap
+
+            ctl = ap.Autopilot(bindings=ap.default_bindings(), enabled=True)
+            for act in ap.client_actuators(mc):
+                ctl.register(act)
         collector = Collector(out_path, console=console,
-                              interval=args.interval)
+                              interval=args.interval, autopilot=ctl)
         spread = SpreadMonitor(mc)
         collector.start()
         spread.start()
         workload = Workload(driver, plan, seed=args.seed,
                             workers=args.workers)
+        killer = None
+        if scenario == "node-kill" and args.datanodes >= 3:
+            # SIGKILL a replica-bearing datanode mid-run: the repair plane
+            # (and the autopilot, when armed) must absorb it — with <3
+            # datanodes there is no replicated volume to survive the loss
+            victim = f"datanode{args.datanodes - 1}"
+            killer = threading.Timer(max(1.0, args.duration * 0.4),
+                                     lambda: cluster.kill(victim))
+            killer.daemon = True
+            killer.start()
         ledger = workload.run()
+        if killer is not None:
+            killer.cancel()
         time.sleep(max(2 * args.interval, 1.0))  # tail windows land
         spread.stop()
         collector.stop()
         out = {"rebalance": rebalance, "report": out_path,
                **collector.verdict(), **ledger,
                "spread": spread.summary()}
+        if ctl is not None:
+            ctl.tick()  # settle gates that expired after the last poll
+            st = ctl.status()
+            by: dict[str, int] = {}
+            for d in st["decisions"]:
+                by[d["decision"]] = by.get(d["decision"], 0) + 1
+            out["autopilot"] = {"enabled": True, "decisions": by,
+                                "actions": by.get("executed", 0),
+                                "rolled_back": by.get("rolled_back", 0),
+                                "budget": st["budget"]}
         if ledger["corruptions"]:
             out["verdict"] = FAILING
             out["flipped"] = {**out.get("flipped", {}),
@@ -931,6 +1001,19 @@ def main(argv=None) -> int:
     p.add_argument("--ab-rebalance", action="store_true",
                    help="run the same seeded scenario twice (rebalance "
                         "off, then on) and report both spreads")
+    p.add_argument("--autopilot", action="store_true",
+                   help="arm the console-fed autopilot: firing alerts "
+                        "drive master actuators through the declarative "
+                        "bindings, gated by budget/cooldown/flap damping")
+    p.add_argument("--ab-autopilot", action="store_true",
+                   help="run the same seeded scenario twice (autopilot "
+                        "off, then on); only the ON phase gates the exit "
+                        "code — the OFF phase is the control arm")
+    p.add_argument("--scenario", default="none",
+                   choices=("none", "hotspot", "tenant-storm", "node-kill"),
+                   help="canned stress: zipf hotspot under a spike ramp, "
+                        "one tenant soaking 60%% of arrivals, or a "
+                        "mid-run datanode SIGKILL (needs --datanodes>=3)")
     p.add_argument("--json", action="store_true")
     args = p.parse_args(argv)
 
@@ -953,6 +1036,26 @@ def main(argv=None) -> int:
                       "spread_cv_on": res_on["spread"]["cv"]}
             failing = (res_off["verdict"] == FAILING
                        or res_on["verdict"] == FAILING)
+        elif args.ab_autopilot:
+            res_off = run_capacity(
+                args, rebalance=args.rebalance, autopilot=False,
+                root=os.path.join(root, "off"),
+                out_path=args.out or os.path.join(root, "capacity-off.jsonl"))
+            res_on = run_capacity(
+                args, rebalance=args.rebalance, autopilot=True,
+                root=os.path.join(root, "on"),
+                out_path=(args.out + ".on" if args.out
+                          else os.path.join(root, "capacity-on.jsonl")))
+            result = {"metric": "capacity_ab_autopilot", "seed": args.seed,
+                      "scenario": args.scenario,
+                      "off": res_off, "on": res_on,
+                      "verdict_off": res_off["verdict"],
+                      "verdict_on": res_on["verdict"],
+                      "actions_on": (res_on.get("autopilot") or {})
+                      .get("actions", 0)}
+            # the control arm is EXPECTED to degrade under a scenario —
+            # only the closed-loop arm gates the exit code
+            failing = res_on["verdict"] == FAILING
         else:
             res = run_capacity(
                 args, rebalance=args.rebalance, root=root,

@@ -15,7 +15,8 @@ reads the result after the cluster is gone.
 `inspect` renders cause→evidence: the firing alert, its burn-rate window,
 the top-moving metric families over the frozen snapshots, the slowest
 spans, the in-window slowops (trace ids joined against the event
-timeline), and the hot profile thread buckets.
+timeline), the autopilot actions taken (or damped/refused) inside the
+window, and the hot profile thread buckets.
 
 Also a library: `read_bundle` / `assemble_incident` / `correlate` /
 `summarize` are shared with the console collector and the `--bundle`
@@ -207,6 +208,20 @@ def summarize(bundle: dict) -> dict:
                           "trace_id": rec.get("trace_id", "")})
     spans.sort(key=lambda s: -float(s.get("dur_us") or 0))
 
+    # the autopilot decision log frozen per target (ISSUE 20): name every
+    # action the controller took (or damped/refused) inside the evidence
+    # window, keyed by the causal alert fingerprint
+    w = corr.get("window") or {}
+    w_start, w_end = w.get("start", 0.0), w.get("end", float("inf"))
+    autopilot = []
+    for tname, payload in targets.items():
+        ap = payload.get("autopilot") or {}
+        for rec in ap.get("decisions") or []:
+            ts = rec.get("ts", 0.0)
+            if isinstance(ts, (int, float)) and w_start <= ts <= w_end:
+                autopilot.append({"target": tname, **rec})
+    autopilot.sort(key=lambda r: r.get("ts", 0.0))
+
     profile: dict[str, int] = {}
     coverage = []
     for payload in targets.values():
@@ -228,6 +243,7 @@ def summarize(bundle: dict) -> dict:
             "slow_spans": spans[:10],
             "slowops": corr.get("slowops", [])[:10],
             "trace_ids": corr.get("trace_ids", []),
+            "autopilot_actions": autopilot[-20:],
             "profile_hot": [{"bucket": b, "samples": n} for b, n in hot],
             "profile_coverage": round(sum(coverage) / len(coverage), 4)
             if coverage else 0.0}
@@ -273,6 +289,13 @@ def render_summary(s: dict, out) -> None:
                   f"{r.get('op', '?')}  {float(r.get('latency_ms', 0)):.1f}ms"
                   f"  trace={r.get('trace_id', '-')}  @{r['target']}",
                   file=out)
+    if s.get("autopilot_actions"):
+        print("  autopilot actions in window:", file=out)
+        for r in s["autopilot_actions"]:
+            print(f"    {_fmt_ts(r.get('ts', 0))}  "
+                  f"{r.get('decision', '?'):<12} "
+                  f"{r.get('actuator') or '-':<24} "
+                  f"{r.get('fingerprint', '')}  @{r['target']}", file=out)
     if s["profile_hot"]:
         print(f"  hot profile buckets "
               f"(coverage {s['profile_coverage']:.0%}):", file=out)

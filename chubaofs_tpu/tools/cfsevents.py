@@ -11,12 +11,20 @@ wall-clock-ordered timeline via the console's `/api/events` rollup
     cfs-events --console C --type disk_status,task_finished --follow
     cfs-events --console C --alerts
     cfs-events --console C --correlate 8f3a...   # events ⋈ trace spans
+    cfs-events --console C --correlate 'slo_failing|slo=put_p99'
 
 `--correlate <trace-id>` joins the timeline against the trace sink: events
 carrying that trace id and the trace's spans (console `/api/trace`, or each
 daemon's `/traces`) interleave into one causally-ordered view — the
 injected-fault → detection → repair-lease → rebuild-finished chain the
 chaos kill soak asserts on, readable by a human.
+
+`--correlate <alert-fingerprint>` instead joins the alert lifecycle against
+the autopilot's decision log: the alert_firing edge, every `autopilot_*`
+decision stamped with that causal fingerprint, and the alert_resolved edge,
+each line carrying its wall-time delta from the firing edge — the auditable
+cause→action→resolution chain (an argument that matches no alert falls
+back to the trace join, so one flag serves both).
 
 `--follow` keeps polling with the rollup cursor, printing only new events
 (tail -f for the cluster). Unreachable targets print as warnings, never
@@ -171,6 +179,46 @@ def render_alerts(roll: dict) -> str:
     return "\n".join(lines)
 
 
+def event_fingerprint(e: dict) -> str | None:
+    """The alert fingerprint an event belongs to, or None: autopilot_*
+    decisions carry it verbatim in detail.fingerprint (the causal stamp);
+    alert_firing/alert_resolved reconstruct it from entity + labels —
+    the same fingerprint() the alert manager dedupes by."""
+    from chubaofs_tpu.utils.alerts import fingerprint
+
+    d = e.get("detail") or {}
+    if str(e.get("type", "")).startswith("autopilot_"):
+        return str(d.get("fingerprint", "")) or None
+    if e.get("type") in ("alert_firing", "alert_resolved"):
+        return fingerprint(e.get("entity", ""), d.get("labels"))
+    return None
+
+
+def correlate_alert_chain(events: list[dict], fp: str) -> list[dict]:
+    """The cause→action→resolution join (ISSUE 20): every alert_firing /
+    autopilot_* / alert_resolved event belonging to one alert fingerprint,
+    wall-ordered, each stamped with the delta since the chain's most
+    recent firing edge — so `fired +0.0s → executed +2.1s → resolved
+    +9.8s` reads straight down. Empty when the fingerprint matched no
+    alert lifecycle (the caller falls back to the trace-span join)."""
+    chain = [e for e in events if event_fingerprint(e) == fp]
+    chain.sort(key=lambda e: e.get("ts", 0.0))
+    items: list[dict] = []
+    t_fire: float | None = None
+    for e in chain:
+        if e.get("type") == "alert_firing":
+            t_fire = e.get("ts", 0.0)
+        dt = None if t_fire is None \
+            else round(e.get("ts", 0.0) - t_fire, 3)
+        kind = "alert" if str(e.get("type", "")).startswith("alert_") \
+            else "action"
+        mark = "cause    " if e.get("type") == "alert_firing" \
+            else (f"+{dt:.3f}s" if dt is not None else "?        ")
+        items.append({"t": e.get("ts", 0.0), "kind": kind, "dt": dt,
+                      "record": e, "line": f"{mark:>10}  {fmt_event(e)}"})
+    return items
+
+
 def correlate(events: list[dict], spans: list[dict],
               trace_id: str) -> list[dict]:
     """The join: events carrying the trace id + the trace's spans, merged
@@ -271,8 +319,10 @@ def main(argv=None, out=None) -> int:
                    help="--follow poll period (s)")
     p.add_argument("--alerts", action="store_true",
                    help="show the merged alert view instead of the timeline")
-    p.add_argument("--correlate", default="", metavar="TRACE_ID",
-                   help="join events against this trace's spans")
+    p.add_argument("--correlate", default="", metavar="TRACE_ID|ALERT_FP",
+                   help="join events against this trace's spans, or — given "
+                        "an alert fingerprint — print its cause→action→"
+                        "resolution chain with wall-time deltas")
     p.add_argument("--bundle", default="",
                    help="read from a collected flight-recorder bundle dir "
                         "instead of live side-doors (postmortem mode)")
@@ -315,6 +365,28 @@ def main(argv=None, out=None) -> int:
         events = [e for e in events if e.get("ts", 0.0) >= floor]
 
     if args.correlate:
+        # an alert fingerprint takes precedence over a trace id: when the
+        # argument names an alert lifecycle in the window, render the
+        # cause→action→resolution chain (ISSUE 20); otherwise it is a
+        # trace id and the events ⋈ spans join applies
+        chain = correlate_alert_chain(events, args.correlate)
+        if chain:
+            if args.json:
+                print(json.dumps({"fingerprint": args.correlate,
+                                  "items": chain},
+                                 default=str, indent=2), file=out)
+            else:
+                acts = sum(1 for i in chain if i["kind"] == "action")
+                resolved = any(
+                    i["record"].get("type") == "alert_resolved"
+                    for i in chain)
+                print(f"alert {args.correlate}: {len(chain)} items "
+                      f"({acts} autopilot action(s), "
+                      f"{'resolved' if resolved else 'still firing'})",
+                      file=out)
+                for item in chain:
+                    print(item["line"], file=out)
+            return 0
         spans = (bundle_spans(bundle, args.correlate)
                  if bundle is not None
                  else fetch_spans(args.console, args.addr, args.correlate))

@@ -38,7 +38,12 @@ one row per daemon target:
     boot stamp that MOVED between frames is a confirmed restart — the row
     tags `(restart)` from that cross-check, not just from negative-delta
     clamping (which a counter reset can also cause);
-  * ALERTS — alert instances currently firing (`cfs_alerts_firing`).
+  * ALERTS — alert instances currently firing (`cfs_alerts_firing`);
+  * AUTO — autopilot plane (ISSUE 20): `actions/budget` — real actuator
+    runs in the window (`cfs_autopilot_decisions{decision="executed"}`
+    delta, restart-clamped) over the remaining hourly action budget
+    (`cfs_autopilot_budget_remaining` gauge); '-' when this target's
+    controller is disarmed (`cfs_autopilot_armed` absent or 0).
 
 `--once` renders a single frame (two scrapes `--interval` apart) for CI and
 scripts; without it the terminal refreshes in place until ^C. `--addr`
@@ -63,7 +68,7 @@ from chubaofs_tpu.utils.slo import FAILING, RANK
 
 COLUMNS = ("TARGET", "SLO", "UP", "PUT/S", "GET/S", "PUT99MS", "CONNS",
            "BP/S", "LAG99", "CODEC/B", "CACHE%", "RDAMP", "THR%", "META",
-           "REPAIRQ", "REPB/SH", "ALERTS")
+           "REPAIRQ", "REPB/SH", "ALERTS", "AUTO")
 
 
 # -- scraping ------------------------------------------------------------------
@@ -158,20 +163,25 @@ def _p99(prev: dict, cur: dict, family: str) -> float | None:
     return hist_quantile(buckets, count, 0.99)
 
 
-def _kind_delta(prev: dict, cur: dict, family: str, kind: str) -> float:
-    """Restart-clamped window delta of ONE kind-labeled series of a family —
-    family_sum would fold requested/shards_read/decoded together, and the
-    read-amp ratio needs them apart."""
+def _label_delta(prev: dict, cur: dict, family: str, label: str,
+                 value: str) -> float:
+    """Restart-clamped window delta of the series of `family` whose
+    `label` equals `value` — family_sum would fold the labeled series
+    together, and ratio/selector cells need one slice apart."""
     tot = 0.0
     for k, v in cur.items():
         name, labels = parse_key(k)
-        if name != family or labels.get("kind") != kind:
+        if name != family or labels.get(label) != value:
             continue
         d = v - prev.get(k, 0.0)
         if d < 0:
             d = v  # counter restarted: the post-restart total is the window
         tot += d
     return tot
+
+
+def _kind_delta(prev: dict, cur: dict, family: str, kind: str) -> float:
+    return _label_delta(prev, cur, family, "kind", kind)
 
 
 def _hottest_pid_rate(prev: dict, cur: dict, dt: float) -> float:
@@ -213,6 +223,12 @@ def compute_row(target: str, prev: dict | None, cur: dict | None,
     row["conns"] = int(family_sum(cur, "cfs_evloop_conns"))
     row["repair_q"] = int(family_sum(cur, "cfs_scheduler_tasks"))
     row["alerts"] = int(family_sum(cur, "cfs_alerts_firing"))
+    # autopilot plane (ISSUE 20): armed flag + remaining budget are state
+    # gauges (current frame); the actions count is a window delta below
+    row["auto_armed"] = family_sum(cur, "cfs_autopilot_armed") > 0
+    row["auto_budget"] = int(
+        family_sum(cur, "cfs_autopilot_budget_remaining")) \
+        if row["auto_armed"] else None
     # UP from the boot stamp (wall protocol: the daemon exports ITS wall
     # boot time, we subtract OUR wall clock — same contract as heartbeats)
     boot = family_sum(cur, "cfs_boot_time_seconds")
@@ -274,6 +290,12 @@ def compute_row(target: str, prev: dict | None, cur: dict | None,
     rep_sh = _rate(prev, cur, "cfs_scheduler_repaired_shards", 1.0)
     rep_b = _rate(prev, cur, "cfs_scheduler_repair_bytes_downloaded", 1.0)
     row["repair_bps"] = round(rep_b / rep_sh, 1) if rep_sh > 0 else None
+    # autopilot actions this window: only REAL actuator runs count
+    # (considered/damped/refused decisions are bookkeeping, not actions);
+    # restart-clamped like every flow cell
+    row["auto_acts"] = int(_label_delta(
+        prev, cur, "cfs_autopilot_decisions", "decision", "executed")) \
+        if row.get("auto_armed") else None
     return row
 
 
@@ -304,6 +326,15 @@ def _meta_cell(r: dict) -> str:
     return f"{r['meta_parts']}/{_cell(r.get('meta_hot_ops'))}"
 
 
+def _auto_cell(r: dict) -> str:
+    """AUTO column: `actions/budget` (window actuator runs over remaining
+    hourly budget, e.g. `1/5`); '-' when the controller is disarmed.
+    actions is '-' on the first frame (no prior to delta against)."""
+    if not r.get("auto_armed"):
+        return "-"
+    return f"{_cell(r.get('auto_acts'))}/{_cell(r.get('auto_budget'))}"
+
+
 def render(rows: list[dict], errors: list[str] = ()) -> str:
     if not rows:
         return "(no targets)" + ("".join(f"\n! {e}" for e in errors))
@@ -320,7 +351,7 @@ def render(rows: list[dict], errors: list[str] = ()) -> str:
               _cell(r.get("read_amp")),
               _cell(r.get("thr_pct")), _meta_cell(r),
               _cell(r.get("repair_q")), _cell(r.get("repair_bps")),
-              _cell(r.get("alerts"))]
+              _cell(r.get("alerts")), _auto_cell(r)]
              for r in rows]
     widths = [max(len(COLUMNS[i]), max(len(row[i]) for row in cells))
               for i in range(len(COLUMNS))]

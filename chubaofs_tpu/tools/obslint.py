@@ -70,6 +70,16 @@
    first argument (including computed `"a" if c else "b"` forms) or an
    `etype`-named assignment anywhere in the package.
 
+9. **Every actuator invocation in `autopilot/` emits a typed event.** The
+   autopilot's whole contract is the auditable cause→action→resolution
+   timeline: an actuator `.apply(`/`.rollback(` call whose function emits no
+   `autopilot_*` event is an invisible actuation — the cluster changed and
+   the timeline can't say why, which is exactly the operator trust the
+   closed loop lives or dies on. Scoped per FUNCTION (the emit must share
+   the function with the invocation, so the event can carry the causal
+   fingerprint from the same frame); a reasoned `# obslint: <why>` pragma
+   documents a true exception.
+
 Wired into tier-1 (tests/test_obslint.py) so a regression fails fast.
 
 File-walk, pragma, and CLI plumbing live in tools/lintcore.py, shared with
@@ -123,6 +133,17 @@ PRINT_OK_DIRS = ("tools", "cli")
 # and the sanitizer's structured stderr line; tools/cli stderr is operator
 # diagnostics (their stdout is the interface, rule 6's contract)
 EVENTS_OK_DIRS = ("utils", "tools", "cli")
+
+
+# rule 9's scope: the closed-loop controller package, where every actuator
+# invocation must leave a fingerprint-stamped record on the timeline
+AUTOPILOT_DIR = "autopilot"
+ACTUATOR_CALL_ATTRS = ("apply", "rollback")
+
+
+def _in_autopilot_dir(relpath: str) -> bool:
+    parts = relpath.replace("\\", "/").split("/")
+    return AUTOPILOT_DIR in parts[:-1]
 
 
 def _in_print_ok_dir(relpath: str) -> bool:
@@ -296,6 +317,64 @@ def lint_source(src: str, relpath: str) -> list[str]:
                         f"`self.{tgt.attr} = {{...}}` — counters belong in "
                         "exporter.registry(<role>) so /metrics can render "
                         "them (allowlisted legacy views excepted)")
+    # -- rule 9: silent actuator invocations inside autopilot/ --------------
+    if _in_autopilot_dir(relpath):
+        findings.extend(_lint_actuator_emits(tree, src_lines, relpath))
+    return findings
+
+
+def _scope_calls(fn: ast.AST):
+    """Call nodes in a function's OWN scope — nested def/async-def bodies
+    are their own rule-9 scopes and are not descended into (an emit hidden
+    in a closure can't prove the outer invocation was recorded)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _lint_actuator_emits(tree: ast.AST, src_lines: list[str],
+                         relpath: str) -> list[str]:
+    """Rule 9: inside autopilot/, any function invoking an actuator
+    (`<x>.apply(` / `<x>.rollback(`) must, in the SAME function, emit an
+    event whose type literal starts `autopilot_` — the invocation and its
+    timeline record share a frame, so the record carries the causal
+    fingerprint. `# obslint: <why>` on the invocation line escapes."""
+    findings: list[str] = []
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        invocations: list[ast.Call] = []
+        emits_typed = False
+        for call in _scope_calls(fn):
+            f = call.func
+            if isinstance(f, ast.Attribute) \
+                    and f.attr in ACTUATOR_CALL_ATTRS:
+                invocations.append(call)
+            name = (f.attr if isinstance(f, ast.Attribute)
+                    else f.id if isinstance(f, ast.Name) else "")
+            if "emit" in name and call.args:
+                for sub in ast.walk(call.args[0]):
+                    if isinstance(sub, ast.Constant) \
+                            and isinstance(sub.value, str) \
+                            and sub.value.startswith("autopilot_"):
+                        emits_typed = True
+        if emits_typed:
+            continue
+        for call in invocations:
+            if lintcore.has_pragma(src_lines, call.lineno, "obslint"):
+                continue
+            findings.append(
+                f"{relpath}:{call.lineno}: actuator `.{call.func.attr}(` in "
+                f"`{fn.name}` with no autopilot_* event emitted in the same "
+                "function — an unrecorded actuation breaks the cause→action"
+                "→resolution audit trail; emit autopilot_executed/"
+                "autopilot_rolled_back here (or pragma with "
+                "`# obslint: <why>`)")
     return findings
 
 
@@ -351,9 +430,9 @@ def lint_event_types(root: str | None = None) -> list[str]:
 
 
 def run(root: str | None = None) -> list[str]:
-    """Lint every .py file under the package (rules 1-7), then the
-    package-global event-type coverage pass (rule 8); returns all
-    findings."""
+    """Lint every .py file under the package (rules 1-7 plus rule 9's
+    autopilot actuator-audit pass), then the package-global event-type
+    coverage pass (rule 8); returns all findings."""
     return lintcore.run_package(lint_source, root) + lint_event_types(root)
 
 

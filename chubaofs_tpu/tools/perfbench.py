@@ -1403,6 +1403,46 @@ def bench_capacity(root: str, duration: float = 3.5, rate: float = 20.0,
     return out
 
 
+def bench_rebalance_spread(root: str, duration: float = 6.0,
+                           rate: float = 30.0, seed: int = 7,
+                           datanodes: int = 5) -> dict:
+    """Spread-reduction-under-skew A/B (ROADMAP item 9 leftover): the
+    `cfs-capacity --ab-rebalance` scenario as a tracked BENCH number. The
+    same seeded zipf-hot plan (s=3.0 under a spike ramp — one scorching
+    volume head) runs over two daemon clusters, hot-volume rebalance sweep
+    off then on; the number is the per-datanode op-spread CV the sweep
+    buys back. Flight recorders stay disarmed (CFS_FLIGHT=0) so the A/B
+    measures the data plane, not capture overhead."""
+    import argparse
+
+    from chubaofs_tpu.tools.capacity import run_capacity
+
+    args = argparse.Namespace(
+        seed=seed, tenants=3, zipf_s=3.0, ramp="spike", duration=duration,
+        rate=rate, keys=32, workers=6, interval=0.5, masters=1,
+        metanodes=3, datanodes=datanodes, failpoints="",
+        daemon_env=["CFS_FLIGHT=0"], cache_mb=0, s3=False,
+        rebalance_secs=1.0, autopilot=False, scenario="none")
+    out: dict = {}
+    res_off = run_capacity(args, rebalance=False,
+                           root=os.path.join(root, "off"),
+                           out_path=os.path.join(root, "cap-off.jsonl"))
+    res_on = run_capacity(args, rebalance=True,
+                          root=os.path.join(root, "on"),
+                          out_path=os.path.join(root, "cap-on.jsonl"))
+    cv_off = res_off["spread"]["cv"]
+    cv_on = res_on["spread"]["cv"]
+    out["cap_ab_spread_cv_off"] = cv_off
+    out["cap_ab_spread_cv_on"] = cv_on
+    out["cap_ab_spread_reduction"] = (round((cv_off - cv_on) / cv_off, 3)
+                                      if cv_off > 0 else 0.0)
+    out["cap_ab_verdict_off"] = res_off["verdict"]
+    out["cap_ab_verdict_on"] = res_on["verdict"]
+    log(f"  ab-rebalance: spread cv {cv_off} -> {cv_on} "
+        f"(reduction {out['cap_ab_spread_reduction']})")
+    return out
+
+
 def bench_cache_zipf(root: str, objects: int = 32, obj_kb: int = 64,
                      gets: int = 240, zipf_s: float = 1.1,
                      wire_ms: float = 2.0, cache_mb: int = 64,
@@ -1841,6 +1881,16 @@ def run(root: str, n_files: int = 600, n_clients: int = 4,
     else:  # smoke invocations get a smoke-size A/B
         cfg.update(bench_repair_codes(os.path.join(root, "repaircodes"),
                                       stripes=4, blob_kb=60))
+    # the rebalance-spread A/B boots two more ProcClusters — same post-
+    # cluster slot (floor-deflation lesson); smoke invocations get a
+    # shorter skew window over the 3-node floor
+    log("rebalance spread (cfs-capacity --ab-rebalance A/B)...")
+    if n_files >= 300:
+        cfg.update(bench_rebalance_spread(os.path.join(root, "rebalab")))
+    else:
+        cfg.update(bench_rebalance_spread(os.path.join(root, "rebalab"),
+                                          duration=3.0, rate=15.0,
+                                          datanodes=3))
     _dump_metrics(cfg)
     return cfg
 

@@ -109,16 +109,19 @@ def fingerprint(rule_name: str, labels: dict | None) -> str:
         f"|{k}={v}" for k, v in sorted((labels or {}).items()))
 
 
-# -- firing hooks (the alert-lifecycle subscription, ROADMAP item 4) -----------
+# -- lifecycle hooks (the alert-lifecycle subscription, ROADMAP item 4) --------
 #
-# Callbacks run AFTER the firing transition's event+counter, outside the
-# manager lock, on the evaluating thread — cb(fingerprint, instance_report).
+# Callbacks run AFTER the transition's event+counter, outside the manager
+# lock, on the evaluating thread — cb(fingerprint, instance_report).
 # Private managers (soak probes) never invoke them, same as they never
 # publish the cfs_alerts_firing gauge: a probe's synthetic windows must not
 # trigger the serving process's incident machinery. A raising hook is
-# swallowed — subscribers must not kill the evaluator.
+# swallowed — subscribers must not kill the evaluator. on_resolved mirrors
+# on_firing for the RESOLVED edge: the autopilot's strict-improvement gate
+# confirms a nudge helped by watching the triggering alert clear.
 
 _firing_hooks: list = []
+_resolved_hooks: list = []
 
 
 def on_firing(cb) -> None:
@@ -129,6 +132,18 @@ def on_firing(cb) -> None:
 def remove_firing_hook(cb) -> None:
     try:
         _firing_hooks.remove(cb)
+    except ValueError:
+        pass
+
+
+def on_resolved(cb) -> None:
+    if cb not in _resolved_hooks:
+        _resolved_hooks.append(cb)
+
+
+def remove_resolved_hook(cb) -> None:
+    try:
+        _resolved_hooks.remove(cb)
     except ValueError:
         pass
 
@@ -316,10 +331,10 @@ class AlertManager:
                         {"rule": inst.rule.name, "state": state}).add()
         if not self.private:
             for state, inst in transitions:
-                if state != STATE_FIRING:
-                    continue
+                hooks = _firing_hooks if state == STATE_FIRING \
+                    else _resolved_hooks
                 fp = fingerprint(inst.rule.name, inst.labels)
-                for cb in list(_firing_hooks):
+                for cb in list(hooks):
                     try:
                         cb(fp, inst.report())
                     except Exception:

@@ -82,6 +82,11 @@ EVENT_TYPES = (
     "qos_throttle",         # gateway QoS throttled a tenant (episode, 1/s)
     "bench_tick",           # perfbench events-overhead smoke traffic
     "incident_capture",     # flight recorder froze a capture bundle
+    "autopilot_considered",  # a firing alert matched an armed binding
+    "autopilot_damped",      # flap damper / cooldown held an action back
+    "autopilot_refused",     # hourly action budget exhausted
+    "autopilot_executed",    # an actuator ran (or dry-run logged intent)
+    "autopilot_rolled_back",  # strict-improvement gate undid a nudge
 )
 
 _SEV_RANK = {SEV_INFO: 0, SEV_WARNING: 1, SEV_CRITICAL: 2}

@@ -3,8 +3,8 @@
 When an alert transitions to firing — or an operator/harness asks
 explicitly — freeze the pre-incident window this process already holds in
 its observability rings into one on-disk *bundle* directory: the last K
-metric-history snapshots, the recent event ring, recent traces + slowops, a
-bounded on-demand profile (or the continuous profiler's aggregate when one
+metric-history snapshots, the recent event ring, recent traces + slowops,
+the autopilot decision log, a bounded on-demand profile (or the continuous profiler's aggregate when one
 is armed), the lock-sanitizer report, the CFS_* knob dump, and boot/build
 info. The rings rotate in minutes; the bundle is the evidence that
 survives to the postmortem.
@@ -50,7 +50,7 @@ SLOWOPS_N = 200
 PROFILE_SECONDS = 0.25  # on-demand profile bound when none is armed
 
 SECTIONS = ("meta", "alert", "metrics", "events", "traces", "slowops",
-            "profile", "locks", "config")
+            "autopilot", "profile", "locks", "config")
 
 _FALSEY = ("", "0", "false", "no")
 
@@ -128,6 +128,15 @@ def _gather_slowops() -> dict:
     from chubaofs_tpu.utils import auditlog
 
     return {"slowops": auditlog.recent_slowops(SLOWOPS_N)}
+
+
+def _gather_autopilot() -> dict:
+    # the controller's decision ring + arming state, frozen at incident
+    # time — cfs-doctor names the actions the autopilot took (or refused)
+    # inside the window. Disarmed processes freeze the stub status.
+    from chubaofs_tpu.autopilot import controller
+
+    return controller.autopilot_status()
 
 
 def _gather_profile(profile_s: float) -> dict:
@@ -208,6 +217,7 @@ class FlightRecorder:
                 "events": _gather_events,
                 "traces": _gather_traces,
                 "slowops": _gather_slowops,
+                "autopilot": _gather_autopilot,
                 "profile": lambda: _gather_profile(profile_s),
                 "locks": _gather_locks,
                 "config": _gather_config,

@@ -212,6 +212,72 @@ def test_flags_sendall_of_encoded_packet():
     assert obslint.lint_source(text, "sdk/somewhere.py") == []
 
 
+# -- rule 9: actuator invocations in autopilot/ must emit a typed event --------
+
+
+def test_flags_silent_actuator_invocation_in_autopilot():
+    src = textwrap.dedent("""
+        def fire(self, act, fp, report):
+            return act.apply(fp, report)
+    """)
+    findings = obslint.lint_source(src, "autopilot/controller.py")
+    assert len(findings) == 1 and "autopilot_" in findings[0]
+    assert "fire" in findings[0]
+    # checkout-root relpaths agree (segment match, as in rules 6/7)
+    assert len(obslint.lint_source(
+        src, "chubaofs_tpu/autopilot/controller.py")) == 1
+    # the same source outside autopilot/ is not this rule's business
+    assert obslint.lint_source(src, "master/master.py") == []
+
+
+def test_actuator_with_same_function_emit_passes():
+    src = textwrap.dedent("""
+        def fire(self, act, fp, report):
+            undo = act.apply(fp, report)
+            self._emit_decision("autopilot_executed", "executed", fp, report)
+            return undo
+    """)
+    assert obslint.lint_source(src, "autopilot/controller.py") == []
+    # plain events.emit() works too, and .rollback( is covered the same way
+    rb = textwrap.dedent("""
+        def undo(self, act, p, fp):
+            act.rollback(p)
+            events.emit("autopilot_rolled_back", "warning", entity=fp)
+    """)
+    assert obslint.lint_source(rb, "autopilot/controller.py") == []
+
+
+def test_actuator_emit_in_nested_closure_does_not_count():
+    # the emit must share the invocation's frame — a closure that MIGHT
+    # run later can't prove the actuation was recorded
+    src = textwrap.dedent("""
+        def fire(self, act, fp, report):
+            def later():
+                events.emit("autopilot_executed", "info")
+            act.apply(fp, report)
+            return later
+    """)
+    assert len(obslint.lint_source(src, "autopilot/controller.py")) == 1
+
+
+def test_actuator_pragma_and_wrong_type_emit():
+    pragma = ("def fire(self, act, fp, r):\n"
+              "    return act.apply(fp, r)"
+              "  # obslint: probe call, caller records the decision\n")
+    assert obslint.lint_source(pragma, "autopilot/controller.py") == []
+    # a bare tag with no reason does NOT suppress
+    bare = ("def fire(self, act, fp, r):\n"
+            "    return act.apply(fp, r)  # obslint:\n")
+    assert len(obslint.lint_source(bare, "autopilot/controller.py")) == 1
+    # emitting a NON-autopilot type does not satisfy the audit contract
+    wrong = textwrap.dedent("""
+        def fire(self, act, fp, report):
+            act.apply(fp, report)
+            events.emit("task_finished", "info")
+    """)
+    assert len(obslint.lint_source(wrong, "autopilot/actuators.py")) == 1
+
+
 def test_event_type_without_emit_site_is_flagged(monkeypatch):
     """Rule 8: a name in EVENT_TYPES with no emit( site anywhere in the
     package is a dead timeline contract — inject a phantom entry and the
